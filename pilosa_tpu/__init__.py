@@ -1,0 +1,18 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A from-scratch rebuild of the capabilities of the reference engine (Pilosa, a
+Go distributed bitmap index — see SURVEY.md): same data model (index / field /
+view / 2^20-column shard / fragment), PQL query language, HTTP API and cluster
+behavior, but executed on TPU: roaring container algebra becomes dense uint32
+bitset kernels fused by XLA, fragments live in HBM, per-shard mapReduce
+becomes shard_map over a device mesh with ICI collective reductions.
+"""
+
+__version__ = "0.1.0"
+
+from .core import (  # noqa: F401
+    SHARD_WIDTH,
+    SHARD_WIDTH_EXP,
+    SHARD_WORDS,
+    WORD_BITS,
+)

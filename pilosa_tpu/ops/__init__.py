@@ -1,0 +1,3 @@
+"""Kernel layer: dense bitset + BSI ops (the roaring/ equivalent)."""
+
+from . import bitset, bsi  # noqa: F401

@@ -1,0 +1,20 @@
+"""Test configuration: force an 8-virtual-device CPU platform so multi-chip
+sharding paths (mesh placement, shard_map execution, collectives) are
+exercised without TPU hardware.  Must run before jax initialises."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,132 @@
+"""Differential tests for BSI kernels against a naive dict oracle —
+mirrors the reference's BSI coverage in fragment_internal_test.go
+(SetValue/Sum/Min/Max/Range under every comparison op, negative values)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.ops import bitset, bsi
+
+WORDS = 256
+NBITS = WORDS * 32
+DEPTH = 16
+
+
+def make(rng, n=300, lo=-5000, hi=5000, depth=DEPTH):
+    cols = np.unique(rng.integers(0, NBITS, size=n))
+    vals = rng.integers(lo, hi, size=cols.size)
+    frag = bsi.pack_values(cols, vals, depth=depth, words=WORDS)
+    return cols, vals, frag
+
+
+def test_pack_unpack_roundtrip(rng):
+    cols, vals, frag = make(rng)
+    c2, v2 = bsi.unpack_values(frag)
+    assert np.array_equal(c2, cols)
+    assert np.array_equal(v2, vals)
+
+
+OPS = {
+    "eq": lambda v, p: v == p,
+    "neq": lambda v, p: v != p,
+    "lt": lambda v, p: v < p,
+    "le": lambda v, p: v <= p,
+    "gt": lambda v, p: v > p,
+    "ge": lambda v, p: v >= p,
+}
+
+
+@pytest.mark.parametrize("op", list(OPS))
+@pytest.mark.parametrize("pred", [-70000, -4999, -123, -1, 0, 1, 57, 4999, 70000])
+def test_range_op(rng, op, pred):
+    cols, vals, frag = make(rng)
+    got = set(bitset.unpack_columns(np.asarray(bsi.range_op(frag, op, pred))).tolist())
+    expect = {int(c) for c, v in zip(cols, vals) if OPS[op](v, pred)}
+    assert got == expect
+
+
+def test_range_op_zero_with_negative_zero_sign(rng):
+    # A column whose magnitude is 0 but sign bit is set still holds value 0.
+    frag = np.zeros((2 + 4, WORDS), dtype=np.uint32)
+    frag[bsi.EXISTS_ROW, 0] = 0b1  # col 0 exists
+    frag[bsi.SIGN_ROW, 0] = 0b1    # sign set, magnitude 0
+    assert set(bitset.unpack_columns(
+        np.asarray(bsi.range_op(frag, "eq", 0))).tolist()) == {0}
+    assert set(bitset.unpack_columns(
+        np.asarray(bsi.range_op(frag, "lt", 0))).tolist()) == set()
+    assert set(bitset.unpack_columns(
+        np.asarray(bsi.range_op(frag, "gt", -1))).tolist()) == {0}
+
+
+def test_range_between(rng):
+    cols, vals, frag = make(rng)
+    got = set(bitset.unpack_columns(
+        np.asarray(bsi.range_between(frag, -100, 250))).tolist())
+    expect = {int(c) for c, v in zip(cols, vals) if -100 <= v <= 250}
+    assert got == expect
+
+
+def test_sum(rng):
+    cols, vals, frag = make(rng)
+    s, n = bsi.weighted_sum(np.asarray(bsi.sum_counts(frag)))
+    assert s == int(vals.sum())
+    assert n == cols.size
+
+
+def test_sum_with_filter(rng):
+    cols, vals, frag = make(rng)
+    keep = cols[: cols.size // 2]
+    filt = bitset.pack_columns(keep, words=WORDS)
+    s, n = bsi.weighted_sum(np.asarray(bsi.sum_counts(frag, filt)))
+    assert s == int(vals[: cols.size // 2].sum())
+    assert n == keep.size
+
+
+@pytest.mark.parametrize("want_max", [False, True])
+def test_min_max(rng, want_max):
+    cols, vals, frag = make(rng)
+    out = bsi.min_max_bits(frag, want_max=want_max)
+    val, cnt = bsi.reconstruct_min_max(*[np.asarray(x) for x in out])
+    target = int(vals.max() if want_max else vals.min())
+    assert val == target
+    assert cnt == int((vals == target).sum())
+
+
+@pytest.mark.parametrize("case", [
+    [5, 7, 9], [-5, -7, -9], [-5, 0, 5], [0], [-3, -3, 8],
+])
+def test_min_max_small(case):
+    cols = np.arange(len(case))
+    vals = np.array(case)
+    frag = bsi.pack_values(cols, vals, depth=8, words=WORDS)
+    for want_max in (False, True):
+        out = bsi.min_max_bits(frag, want_max=want_max)
+        val, cnt = bsi.reconstruct_min_max(*[np.asarray(x) for x in out])
+        target = max(case) if want_max else min(case)
+        assert val == target, (case, want_max)
+        assert cnt == case.count(target)
+
+
+def test_min_max_with_filter(rng):
+    cols = np.array([1, 2, 3, 4])
+    vals = np.array([10, -20, 30, -40])
+    frag = bsi.pack_values(cols, vals, depth=8, words=WORDS)
+    filt = bitset.pack_columns(np.array([1, 3]), words=WORDS)
+    out = bsi.min_max_bits(frag, filter_seg=filt, want_max=False)
+    val, cnt = bsi.reconstruct_min_max(*[np.asarray(x) for x in out])
+    assert (val, cnt) == (10, 1)
+    out = bsi.min_max_bits(frag, filter_seg=filt, want_max=True)
+    val, cnt = bsi.reconstruct_min_max(*[np.asarray(x) for x in out])
+    assert (val, cnt) == (30, 1)
+
+
+def test_pack_values_overflow_raises():
+    with pytest.raises(ValueError):
+        bsi.pack_values(np.array([0]), np.array([70000]), depth=16, words=WORDS)
+
+
+def test_min_max_empty_returns_zero_count():
+    frag = np.zeros((2 + 4, WORDS), dtype=np.uint32)
+    out = bsi.min_max_bits(frag, want_max=False)
+    val, cnt = bsi.reconstruct_min_max(*[np.asarray(x) for x in out])
+    assert (val, cnt) == (0, 0)
