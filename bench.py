@@ -77,7 +77,7 @@ def main():
             seg = frag_np[qrows0[q, 0]]
             for i in range(1, 8):
                 seg = seg & frag_np[qrows0[q, i]]
-            int(np.unpackbits(seg.view(np.uint8)).sum())
+            int(np.bitwise_count(seg).sum())
     t1 = time.perf_counter()
     cpu_qps = (B * cpu_iters) / (t1 - t0)
 
@@ -85,7 +85,7 @@ def main():
     seg = frag_np[qrows0[0, 0]]
     for i in range(1, 8):
         seg = seg & frag_np[qrows0[0, i]]
-    assert int(np.asarray(out)[0]) == int(np.unpackbits(seg.view(np.uint8)).sum())
+    assert int(np.asarray(out)[0]) == int(np.bitwise_count(seg).sum())
 
     print(json.dumps({
         "metric": "intersect8_count_qps_1M_cols",

@@ -1,0 +1,403 @@
+"""Fragment: one (field, view, shard) bitmap, host-authoritative with a
+device-resident HBM mirror.
+
+The reference's fragment (fragment.go:100-159) is an mmap'd roaring file with
+an append-only op log and background snapshot rewrites.  Here the
+authoritative copy is a dense ``uint32[n_rows, SHARD_WORDS]`` numpy array on
+the host; mutations (set/clear/setValue/import) update it immediately and
+append to a write-ahead op log.  The device mirror is uploaded lazily on first
+query after a write burst and stays resident in HBM (the mmap replacement) so
+repeated queries never re-cross PCIe/DCN.  Snapshots rewrite the on-disk file
+and truncate the WAL after ``max_op_n`` ops (fragment.go:84 MaxOpN, :2311
+snapshot).
+
+Row capacity grows by doubling so device executable shapes change rarely
+(each distinct row count compiles its own XLA plan).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import threading
+
+import numpy as np
+
+from ..core import (
+    DEFAULT_FRAGMENT_MAX_OP_N,
+    HASH_BLOCK_SIZE,
+    SHARD_WIDTH,
+    SHARD_WORDS,
+)
+from ..ops import bitset, bsi
+
+# On-disk snapshot format: magic, n_rows, words, nnz then nnz LE
+# (flat_word_index u32, word_value u32) pairs — sparse, so a 20k-bit fragment
+# snapshot is ~tens of KB instead of a dense n_rows*128KB image.
+_MAGIC = b"PTPUFRG2"
+_HEADER = struct.Struct("<8sIIQ")
+
+# WAL record: op(u8) row(i64) col(i64)  (roaring.go:4359 opType add/remove;
+# batch ops are written as runs of single records).
+_OP = struct.Struct("<Bqq")
+_OP_SET, _OP_CLEAR = 0, 1
+
+_MIN_ROWS = 4
+
+
+class Fragment:
+    """One (index, field, view, shard) bitmap."""
+
+    def __init__(self, path: str | None, index: str, field: str, view: str,
+                 shard: int, max_op_n: int = DEFAULT_FRAGMENT_MAX_OP_N):
+        self.path = path  # None = purely in-memory (tests)
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+        self.max_op_n = max_op_n
+
+        self.words = np.zeros((0, SHARD_WORDS), dtype=np.uint32)
+        self._device = None       # cached jax.Array mirror
+        self._device_dirty = True
+        self._op_n = 0
+        self._dirty_data = False  # mutated since last snapshot?
+        self._wal_file = None
+        self._lock = threading.RLock()
+
+        if path is not None:
+            self._open_storage()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _snapshot_path(self) -> str:
+        return self.path
+
+    def _wal_path(self) -> str:
+        return self.path + ".wal"
+
+    def _open_storage(self):
+        """Load snapshot + replay WAL (fragment.go:311 openStorage)."""
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        if os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                magic, n_rows, words, nnz = _HEADER.unpack(
+                    f.read(_HEADER.size))
+                if magic != _MAGIC:
+                    raise ValueError(f"bad fragment file magic in {self.path}")
+                pairs = np.fromfile(f, dtype="<u4", count=2 * nnz)
+            if words != SHARD_WORDS:
+                raise ValueError(
+                    f"fragment file {self.path} has {words} words/row, "
+                    f"expected {SHARD_WORDS}")
+            self.words = np.zeros((n_rows, words), dtype=np.uint32)
+            if nnz:
+                flat = self.words.reshape(-1)
+                flat[pairs[0::2].astype(np.int64)] = pairs[1::2]
+        if os.path.exists(self._wal_path()):
+            with open(self._wal_path(), "rb") as f:
+                buf = f.read()
+            for off in range(0, len(buf) - len(buf) % _OP.size, _OP.size):
+                op, row, col = _OP.unpack_from(buf, off)
+                if op == _OP_SET:
+                    self._set_bit_mem(row, col)
+                else:
+                    self._clear_bit_mem(row, col)
+            self._op_n = len(buf) // _OP.size
+        self._wal_file = open(self._wal_path(), "ab", buffering=0)
+
+    def close(self):
+        with self._lock:
+            if self._wal_file is not None:
+                if self._dirty_data or self._op_n:
+                    self.snapshot()
+                self._wal_file.close()
+                self._wal_file = None
+            self._device = None
+
+    def snapshot(self):
+        """Rewrite the snapshot file and truncate the WAL
+        (fragment.go:2311 snapshot)."""
+        with self._lock:
+            if self.path is None:
+                self._op_n = 0
+                return
+            tmp = self.path + ".snapshotting"
+            with open(tmp, "wb") as f:
+                n_rows, words = self.words.shape
+                flat = self.words.reshape(-1)
+                idx = np.nonzero(flat)[0]
+                if idx.size and int(idx[-1]) >> 32:
+                    raise ValueError("fragment too large for u32 flat index")
+                f.write(_HEADER.pack(_MAGIC, n_rows, words, idx.size))
+                pairs = np.empty(2 * idx.size, dtype="<u4")
+                pairs[0::2] = idx.astype(np.uint32)
+                pairs[1::2] = flat[idx]
+                pairs.tofile(f)
+            os.replace(tmp, self.path)
+            self._dirty_data = False
+            if self._wal_file is not None:
+                self._wal_file.close()
+            self._wal_file = open(self._wal_path(), "wb", buffering=0)
+            self._op_n = 0
+
+    # -- geometry ----------------------------------------------------------
+
+    @property
+    def n_rows(self) -> int:
+        return self.words.shape[0]
+
+    def max_row_id(self) -> int:
+        """Highest row with any bit set (fragment.go maxRow)."""
+        nz = np.nonzero(self.words.any(axis=1))[0]
+        return int(nz[-1]) if nz.size else 0
+
+    def _ensure_rows(self, row_id: int):
+        if row_id < self.n_rows:
+            return
+        new_rows = max(_MIN_ROWS, self.n_rows)
+        while new_rows <= row_id:
+            new_rows *= 2
+        grown = np.zeros((new_rows, SHARD_WORDS), dtype=np.uint32)
+        grown[: self.n_rows] = self.words
+        self.words = grown
+        self._device = None
+        self._device_dirty = True
+
+    # -- mutation ----------------------------------------------------------
+
+    def _set_bit_mem(self, row: int, col: int) -> bool:
+        self._ensure_rows(row)
+        w, bit = bitset.word_bit_np(col)
+        changed = not (self.words[row, w] & bit)
+        if changed:
+            self.words[row, w] |= bit
+            self._device_dirty = True
+            self._dirty_data = True
+        return changed
+
+    def _clear_bit_mem(self, row: int, col: int) -> bool:
+        if row >= self.n_rows:
+            return False
+        w, bit = bitset.word_bit_np(col)
+        changed = bool(self.words[row, w] & bit)
+        if changed:
+            self.words[row, w] &= ~bit
+            self._device_dirty = True
+            self._dirty_data = True
+        return changed
+
+    def _log_op(self, op: int, row: int, col: int):
+        if self._wal_file is not None:
+            self._wal_file.write(_OP.pack(op, row, col))
+        self._op_n += 1
+        if self._op_n >= self.max_op_n:
+            if self._wal_file is not None:
+                self._wal_file.flush()
+            self.snapshot()
+
+    def set_bit(self, row: int, col: int) -> bool:
+        """Set one bit; col is shard-local.  Returns True if changed
+        (fragment.go:647 setBit)."""
+        with self._lock:
+            changed = self._set_bit_mem(row, col)
+            if changed:
+                self._log_op(_OP_SET, row, col)
+            return changed
+
+    def clear_bit(self, row: int, col: int) -> bool:
+        with self._lock:
+            changed = self._clear_bit_mem(row, col)
+            if changed:
+                self._log_op(_OP_CLEAR, row, col)
+            return changed
+
+    def bulk_import(self, rows: np.ndarray, cols: np.ndarray,
+                    clear: bool = False) -> int:
+        """Batched import of shard-local (row, col) bits
+        (fragment.go:1997 bulkImport / 2053 importPositions).  Returns the
+        number of changed bits."""
+        rows = np.asarray(rows, dtype=np.int64)
+        cols = np.asarray(cols, dtype=np.int64)
+        if rows.size == 0:
+            return 0
+        with self._lock:
+            self._ensure_rows(int(rows.max()))
+            w, bit = bitset.word_bit_np(cols)
+            # Only touched rows participate; avoids streaming the whole
+            # fragment for small imports.
+            urows = np.unique(rows)
+            delta = np.zeros((urows.size, self.words.shape[1]),
+                             dtype=np.uint32)
+            rpos = np.searchsorted(urows, rows)
+            np.bitwise_or.at(delta, (rpos, w), bit)
+            target = self.words[urows]
+            if clear:
+                changed_words = target & delta
+                self.words[urows] = target & ~delta
+            else:
+                changed_words = ~target & delta
+                self.words[urows] = target | delta
+            n_changed = int(np.bitwise_count(changed_words).sum())
+            if n_changed:
+                self._device_dirty = True
+                self._dirty_data = True
+                op = _OP_CLEAR if clear else _OP_SET
+                if self._wal_file is not None:
+                    recs = b"".join(
+                        _OP.pack(op, int(r), int(c))
+                        for r, c in zip(rows, cols))
+                    self._wal_file.write(recs)
+                self._op_n += rows.size
+                if self._op_n >= self.max_op_n:
+                    self.snapshot()
+            return n_changed
+
+    def mutex_import(self, rows: np.ndarray, cols: np.ndarray) -> int:
+        """Batched import with mutex semantics: at most one row per column,
+        last write in the batch wins (fragment.go:2106 bulkImportMutex).
+        Returns changed-bit count."""
+        rows = np.asarray(rows, dtype=np.int64)
+        cols = np.asarray(cols, dtype=np.int64)
+        if rows.size == 0:
+            return 0
+        # keep the last occurrence of each column
+        last = {}
+        for i in range(rows.size):
+            last[int(cols[i])] = int(rows[i])
+        ucols = np.fromiter(last.keys(), dtype=np.int64, count=len(last))
+        urow = np.fromiter(last.values(), dtype=np.int64, count=len(last))
+        with self._lock:
+            self._ensure_rows(int(urow.max()))
+            w, bit = bitset.word_bit_np(ucols)
+            colmask = np.zeros(self.words.shape[1], dtype=np.uint32)
+            np.bitwise_or.at(colmask, w, bit)
+            before = int(np.bitwise_count(self.words & colmask).sum())
+            pre_winner = int(np.count_nonzero(self.words[urow, w] & bit))
+            # clear every row's bits at the target columns, then set winners
+            self.words &= ~colmask
+            np.bitwise_or.at(self.words, (urow, w), bit)
+            # changed = bits cleared off losers + winner bits newly set
+            n_changed = (before - pre_winner) + (ucols.size - pre_winner)
+            self._device_dirty = True
+            self._dirty_data = True
+            if self._wal_file is not None:
+                self.snapshot()
+            return max(n_changed, 0)
+
+    def set_row(self, row: int, seg: np.ndarray | None):
+        """Replace an entire row's bits (Store/SetRow, fragment.go setRow)."""
+        with self._lock:
+            self._ensure_rows(row)
+            if seg is None:
+                self.words[row] = 0
+            else:
+                self.words[row] = np.asarray(seg, dtype=np.uint32)
+            self._device_dirty = True
+            self._dirty_data = True
+            self.snapshot()  # row stores bypass the op log
+
+    # -- BSI mutation (int fields) ----------------------------------------
+
+    def bit_depth(self) -> int:
+        return max(0, self.n_rows - bsi.OFFSET_ROW)
+
+    def set_value(self, col: int, bit_depth: int, value: int) -> bool:
+        """Set a column's integer value (fragment.go:977 setValueBase).
+        Grows depth rows as needed; clears stale magnitude bits.  Each
+        changed bit is WAL-logged so values survive a crash like set bits
+        do."""
+        with self._lock:
+            self._ensure_rows(bsi.OFFSET_ROW + bit_depth - 1)
+            mag = abs(value)
+            ops: list[tuple[int, int]] = []
+            for i in range(bit_depth):
+                row = bsi.OFFSET_ROW + i
+                want = (mag >> i) & 1
+                ops.append((_OP_SET if want else _OP_CLEAR, row))
+            ops.append((_OP_SET if value < 0 else _OP_CLEAR, bsi.SIGN_ROW))
+            ops.append((_OP_SET, bsi.EXISTS_ROW))
+            changed = False
+            for op, row in ops:
+                if op == _OP_SET:
+                    if self._set_bit_mem(row, col):
+                        self._log_op(_OP_SET, row, col)
+                        changed = True
+                else:
+                    if self._clear_bit_mem(row, col):
+                        self._log_op(_OP_CLEAR, row, col)
+                        changed = True
+            return changed
+
+    def import_values(self, cols: np.ndarray, values: np.ndarray,
+                      bit_depth: int) -> None:
+        """Batched setValue (fragment.go:2205 importValue)."""
+        cols = np.asarray(cols, dtype=np.int64)
+        values = np.asarray(values, dtype=np.int64)
+        with self._lock:
+            self._ensure_rows(bsi.OFFSET_ROW + bit_depth - 1)
+            w, bit = bitset.word_bit_np(cols)
+            # clear all target columns' bits first (stale values)
+            mask = np.zeros(SHARD_WORDS, dtype=np.uint32)
+            np.bitwise_or.at(mask, w, bit)
+            self.words[: bsi.OFFSET_ROW + bit_depth] &= ~mask
+            packed = bsi.pack_values(cols, values, depth=bit_depth,
+                                     words=SHARD_WORDS)
+            self.words[: packed.shape[0]] |= packed
+            self._device_dirty = True
+            self._dirty_data = True
+            self.snapshot()
+
+    # -- reads -------------------------------------------------------------
+
+    def row(self, row_id: int) -> np.ndarray:
+        """Host copy of one row's segment (fragment.go:602 row)."""
+        with self._lock:
+            if row_id >= self.n_rows:
+                return np.zeros(SHARD_WORDS, dtype=np.uint32)
+            return self.words[row_id].copy()
+
+    def row_columns(self, row_id: int) -> np.ndarray:
+        return bitset.unpack_columns(self.row(row_id))
+
+    def device(self):
+        """The HBM-resident mirror (uploads if stale).  This is the query hot
+        path's input — equivalent to the mmap'd storage the reference queries
+        against (fragment.go:311)."""
+        import jax
+
+        with self._lock:
+            if self._device is None or self._device_dirty:
+                self._device = jax.device_put(self.words)
+                self._device_dirty = False
+            return self._device
+
+    # -- anti-entropy block checksums (fragment.go:1778 Blocks) ------------
+
+    def blocks(self) -> dict[int, bytes]:
+        """Checksum per HASH_BLOCK_SIZE-row block of non-empty rows."""
+        out = {}
+        with self._lock:
+            for start in range(0, self.n_rows, HASH_BLOCK_SIZE):
+                blk = self.words[start:start + HASH_BLOCK_SIZE]
+                if not blk.any():
+                    continue
+                if blk.shape[0] < HASH_BLOCK_SIZE:
+                    # pad so the digest depends only on logical content, not
+                    # on the doubling-based row capacity
+                    pad = np.zeros(
+                        (HASH_BLOCK_SIZE - blk.shape[0], blk.shape[1]),
+                        dtype=np.uint32)
+                    blk = np.concatenate([blk, pad])
+                out[start // HASH_BLOCK_SIZE] = hashlib.blake2b(
+                    blk.tobytes(), digest_size=16).digest()
+        return out
+
+    def block_data(self, block_id: int) -> tuple[np.ndarray, np.ndarray]:
+        """(rows, cols) pairs of one block (fragment.go:1859 blockData)."""
+        start = block_id * HASH_BLOCK_SIZE
+        with self._lock:
+            blk = self.words[start:start + HASH_BLOCK_SIZE]
+            r, c = bitset.unpack_fragment(blk)
+            return r + start, c

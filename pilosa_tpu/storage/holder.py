@@ -1,0 +1,107 @@
+"""Holder: root of all local data (holder.go:50-87)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+
+from .fragment import Fragment
+from .index import Index
+from .field import Field, FieldOptions
+
+
+class Holder:
+    def __init__(self, path: str | None = None,
+                 max_op_n: int | None = None):
+        self.path = path
+        self.max_op_n = max_op_n
+        self.indexes: dict[str, Index] = {}
+        self._lock = threading.RLock()
+
+    # -- lifecycle (holder.go:137 Open) ------------------------------------
+
+    def open(self):
+        if self.path is None:
+            return
+        os.makedirs(self.path, exist_ok=True)
+        for name in sorted(os.listdir(self.path)):
+            idx_path = os.path.join(self.path, name)
+            if not os.path.isdir(idx_path):
+                continue
+            idx = Index(idx_path, name, max_op_n=self.max_op_n)
+            idx.open()
+            self.indexes[name] = idx
+
+    def close(self):
+        with self._lock:
+            for idx in self.indexes.values():
+                idx.close()
+
+    # -- index management --------------------------------------------------
+
+    def _index_path(self, name: str) -> str | None:
+        return None if self.path is None else os.path.join(self.path, name)
+
+    def index(self, name: str) -> Index | None:
+        return self.indexes.get(name)
+
+    def create_index(self, name: str, keys: bool = False,
+                     track_existence: bool = True) -> Index:
+        """(holder.go:396 CreateIndex)"""
+        with self._lock:
+            if name in self.indexes:
+                raise ValueError(f"index already exists: {name}")
+            if not name or not name[0].isalpha() or name != name.lower():
+                raise ValueError(f"invalid index name: {name!r}")
+            idx = Index(self._index_path(name), name, keys=keys,
+                        track_existence=track_existence,
+                        max_op_n=self.max_op_n, create=True)
+            idx.save_meta()
+            self.indexes[name] = idx
+            return idx
+
+    def create_index_if_not_exists(self, name: str, **kw) -> Index:
+        with self._lock:
+            if name in self.indexes:
+                return self.indexes[name]
+            return self.create_index(name, **kw)
+
+    def delete_index(self, name: str):
+        with self._lock:
+            idx = self.indexes.pop(name, None)
+            if idx is None:
+                raise ValueError(f"index not found: {name}")
+            idx.close()
+            if idx.path is not None and os.path.isdir(idx.path):
+                shutil.rmtree(idx.path)
+
+    # -- accessors (holder.go:373-531) ------------------------------------
+
+    def field(self, index: str, field: str) -> Field | None:
+        idx = self.indexes.get(index)
+        return None if idx is None else idx.field(field)
+
+    def fragment(self, index: str, field: str, view: str,
+                 shard: int) -> Fragment | None:
+        f = self.field(index, field)
+        if f is None:
+            return None
+        v = f.view(view)
+        return None if v is None else v.fragment(shard)
+
+    def schema(self) -> list[dict]:
+        """JSON-able schema (holder.go Schema)."""
+        out = []
+        for iname, idx in sorted(self.indexes.items()):
+            out.append({
+                "name": iname,
+                "options": {"keys": idx.keys,
+                            "trackExistence": idx.track_existence},
+                "fields": [
+                    {"name": f.name, "options": f.options.to_dict(),
+                     "views": sorted(f.views)}
+                    for f in idx.public_fields()
+                ],
+            })
+        return out

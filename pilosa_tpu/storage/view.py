@@ -1,0 +1,67 @@
+"""View: a physical grouping of fragments inside a field (view.go:44-63).
+
+Names: "standard", time views "standard_YYYY[MM[DD[HH]]]", and BSI views
+"bsig_<field>".  A view owns one fragment per shard that has data.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from .fragment import Fragment
+
+
+class View:
+    def __init__(self, path: str | None, index: str, field: str, name: str,
+                 max_op_n: int | None = None):
+        self.path = path
+        self.index = index
+        self.field = field
+        self.name = name
+        self.max_op_n = max_op_n
+        self.fragments: dict[int, Fragment] = {}
+        self._lock = threading.RLock()
+
+    def fragment(self, shard: int) -> Fragment | None:
+        return self.fragments.get(shard)
+
+    def create_fragment_if_not_exists(self, shard: int) -> Fragment:
+        """(view.go:263 CreateFragmentIfNotExists)"""
+        with self._lock:
+            frag = self.fragments.get(shard)
+            if frag is None:
+                frag_path = None
+                if self.path is not None:
+                    frag_path = os.path.join(self.path, "fragments", str(shard))
+                kwargs = {}
+                if self.max_op_n is not None:
+                    kwargs["max_op_n"] = self.max_op_n
+                frag = Fragment(frag_path, self.index, self.field, self.name,
+                                shard, **kwargs)
+                self.fragments[shard] = frag
+            return frag
+
+    def available_shards(self) -> set[int]:
+        return set(self.fragments)
+
+    def open(self):
+        """Discover fragment files on disk (view.go openFragments)."""
+        if self.path is None:
+            return
+        frag_dir = os.path.join(self.path, "fragments")
+        if not os.path.isdir(frag_dir):
+            return
+        for name in os.listdir(frag_dir):
+            if name.endswith(".wal"):
+                name = name[:-4]
+            try:
+                shard = int(name)
+            except ValueError:
+                continue
+            self.create_fragment_if_not_exists(shard)
+
+    def close(self):
+        with self._lock:
+            for frag in self.fragments.values():
+                frag.close()

@@ -1,0 +1,294 @@
+"""Storage tree tests — mirrors fragment_internal_test.go /
+field_internal_test.go / holder_internal_test.go coverage: setBit/clearBit,
+WAL+snapshot persistence, BSI set_value/auto-depth, mutex/bool semantics,
+time-view fan-out, import, existence tracking, schema round-trip."""
+
+import numpy as np
+import pytest
+from datetime import datetime
+
+from pilosa_tpu.core import SHARD_WIDTH, EXISTENCE_FIELD_NAME
+from pilosa_tpu.ops import bitset
+from pilosa_tpu.storage import Field, FieldOptions, Fragment, Holder
+from pilosa_tpu.storage import time_quantum as tq
+
+
+# -- fragment ---------------------------------------------------------------
+
+def test_fragment_set_clear_bit():
+    f = Fragment(None, "i", "f", "standard", 0)
+    assert f.set_bit(3, 100)
+    assert not f.set_bit(3, 100)  # already set
+    assert set(f.row_columns(3).tolist()) == {100}
+    assert f.clear_bit(3, 100)
+    assert not f.clear_bit(3, 100)
+    assert f.row_columns(3).size == 0
+
+
+def test_fragment_row_growth():
+    f = Fragment(None, "i", "f", "standard", 0)
+    f.set_bit(0, 1)
+    f.set_bit(1000, 5)
+    assert f.n_rows >= 1001
+    assert f.max_row_id() == 1000
+    assert set(f.row_columns(1000).tolist()) == {5}
+
+
+def test_fragment_bulk_import_and_count():
+    f = Fragment(None, "i", "f", "standard", 0)
+    rows = np.array([0, 0, 1, 5, 5, 5])
+    cols = np.array([1, 2, 3, 4, 5, 4])  # (5,4) duplicated
+    changed = f.bulk_import(rows, cols)
+    assert changed == 5
+    assert f.bulk_import(rows, cols) == 0  # idempotent
+    assert f.bulk_import(np.array([0]), np.array([1]), clear=True) == 1
+    assert set(f.row_columns(0).tolist()) == {2}
+
+
+def test_fragment_persistence(tmp_path):
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0, max_op_n=1000)
+    f.set_bit(2, 7)
+    f.set_bit(9, SHARD_WIDTH - 1)
+    f.clear_bit(2, 7)
+    f.set_value(5, 8, -42)
+    del f
+    g = Fragment(path, "i", "f", "standard", 0)
+    assert g.row_columns(2).size == 0
+    assert set(g.row_columns(9).tolist()) == {SHARD_WIDTH - 1}
+    g.close()
+    # closed fragment reopens identically (snapshot path)
+    h = Fragment(path, "i", "f", "standard", 0)
+    assert set(h.row_columns(9).tolist()) == {SHARD_WIDTH - 1}
+
+
+def test_fragment_wal_replay_without_snapshot(tmp_path):
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0)
+    f.set_bit(1, 10)
+    f.set_bit(1, 11)
+    f._wal_file.flush()
+    # simulate crash: do NOT close/snapshot
+    g = Fragment(path, "i", "f", "standard", 0)
+    assert set(g.row_columns(1).tolist()) == {10, 11}
+
+
+def test_fragment_snapshot_after_max_opn(tmp_path):
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0, max_op_n=5)
+    for c in range(7):
+        f.set_bit(0, c)
+    assert f._op_n < 5  # snapshot triggered and reset
+    g = Fragment(path, "i", "f", "standard", 0)
+    assert set(g.row_columns(0).tolist()) == set(range(7))
+
+
+def test_fragment_set_value_and_blocks():
+    f = Fragment(None, "i", "f", "bsig_f", 0)
+    f.set_value(10, 8, 42)
+    f.set_value(11, 8, -17)
+    from pilosa_tpu.ops import bsi
+    cols, vals = bsi.unpack_values(f.words)
+    assert cols.tolist() == [10, 11]
+    assert vals.tolist() == [42, -17]
+    f.set_value(10, 8, 3)  # overwrite clears stale bits
+    cols, vals = bsi.unpack_values(f.words)
+    assert vals.tolist() == [3, -17]
+    blocks = f.blocks()
+    assert set(blocks) == {0}
+    r, c = f.block_data(0)
+    assert r.size > 0
+
+
+def test_fragment_import_values_overwrites():
+    f = Fragment(None, "i", "f", "bsig_f", 0)
+    f.import_values(np.array([1, 2, 3]), np.array([10, 20, 30]), 8)
+    f.import_values(np.array([2]), np.array([-5]), 8)
+    from pilosa_tpu.ops import bsi
+    cols, vals = bsi.unpack_values(f.words)
+    assert cols.tolist() == [1, 2, 3]
+    assert vals.tolist() == [10, -5, 30]
+
+
+def test_fragment_set_row():
+    f = Fragment(None, "i", "f", "standard", 0)
+    f.set_bit(0, 5)
+    seg = bitset.pack_columns(np.array([7, 8]))
+    f.set_row(0, seg)
+    assert set(f.row_columns(0).tolist()) == {7, 8}
+    f.set_row(0, None)
+    assert f.row_columns(0).size == 0
+
+
+# -- field ------------------------------------------------------------------
+
+def test_field_set_bit_multi_shard():
+    f = Field(None, "i", "f")
+    f.set_bit(1, 5)
+    f.set_bit(1, SHARD_WIDTH + 5)
+    assert f.available_shards() == {0, 1}
+    segs = f.row(1)
+    assert set(bitset.unpack_columns(segs[0]).tolist()) == {5}
+    assert set(bitset.unpack_columns(segs[1]).tolist()) == {5}
+
+
+def test_field_mutex():
+    f = Field(None, "i", "f", FieldOptions(type="mutex"))
+    f.set_bit(1, 100)
+    f.set_bit(2, 100)  # clears row 1
+    segs = f.row(1)
+    assert bitset.unpack_columns(segs[0]).size == 0
+    assert set(bitset.unpack_columns(f.row(2)[0]).tolist()) == {100}
+
+
+def test_field_bool_validates_rows():
+    f = Field(None, "i", "f", FieldOptions(type="bool"))
+    f.set_bit(0, 1)
+    f.set_bit(1, 1)  # flips to true
+    with pytest.raises(Exception):
+        f.set_bit(2, 1)
+
+
+def test_field_time_views():
+    f = Field(None, "i", "f", FieldOptions(type="time", time_quantum="YMD"))
+    ts = datetime(2017, 3, 20, 10)
+    f.set_bit(4, 30, ts=ts)
+    assert set(f.views) == {"standard", "standard_2017", "standard_201703",
+                            "standard_20170320"}
+    for vname in f.views:
+        assert set(bitset.unpack_columns(f.row(4, vname)[0]).tolist()) == {30}
+
+
+def test_field_int_values_and_base():
+    f = Field(None, "i", "f", FieldOptions(type="int", min=100, max=200))
+    assert f.options.base == 100
+    f.set_value(9, 150)
+    assert f.value(9) == (150, True)
+    assert f.value(10) == (0, False)
+    f.set_value(9, 101)
+    assert f.value(9) == (101, True)
+
+
+def test_field_int_auto_depth_growth():
+    f = Field(None, "i", "f", FieldOptions(type="int", min=0, max=10))
+    before = f.options.bit_depth
+    f.set_value(0, 100000)
+    assert f.options.bit_depth > before
+    assert f.value(0) == (100000, True)
+
+
+def test_field_import_values():
+    f = Field(None, "i", "f", FieldOptions(type="int", min=-100, max=100))
+    cols = np.array([1, SHARD_WIDTH + 2, 3])
+    vals = np.array([-50, 75, 0])
+    f.import_values(cols, vals)
+    assert f.value(1) == (-50, True)
+    assert f.value(SHARD_WIDTH + 2) == (75, True)
+    assert f.value(3) == (0, True)
+
+
+def test_field_import_bits_with_time():
+    f = Field(None, "i", "f", FieldOptions(type="time", time_quantum="YM"))
+    ts = datetime(2018, 1, 2)
+    f.import_bits(np.array([1, 1]), np.array([5, 6]), [ts, None])
+    assert set(f.views) == {"standard", "standard_2018", "standard_201801"}
+    assert set(bitset.unpack_columns(f.row(1)[0]).tolist()) == {5, 6}
+    assert set(bitset.unpack_columns(
+        f.row(1, "standard_2018")[0]).tolist()) == {5}
+
+
+# -- holder/index -----------------------------------------------------------
+
+def test_holder_schema_and_persistence(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("myindex")
+    idx.create_field("myfield", FieldOptions(type="set"))
+    idx.create_field("quant", FieldOptions(type="int", min=0, max=1000))
+    f = idx.field("myfield")
+    f.set_bit(1, 200)
+    idx.field("quant").set_value(200, 55)
+    idx.add_existence(np.array([200]))
+    h.close()
+
+    h2 = Holder(str(tmp_path / "data"))
+    h2.open()
+    idx2 = h2.index("myindex")
+    assert idx2 is not None
+    assert {f["name"] for f in h2.schema()[0]["fields"]} == {"myfield", "quant"}
+    assert set(bitset.unpack_columns(
+        idx2.field("myfield").row(1)[0]).tolist()) == {200}
+    assert idx2.field("quant").value(200) == (55, True)
+    assert set(bitset.unpack_columns(
+        idx2.existence_row()[0]).tolist()) == {200}
+    h2.close()
+
+
+def test_holder_delete_index(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    h.create_index("a")
+    h.delete_index("a")
+    assert h.index("a") is None
+    with pytest.raises(ValueError):
+        h.delete_index("a")
+
+
+def test_index_validates_names(tmp_path):
+    h = Holder(None)
+    with pytest.raises(ValueError):
+        h.create_index("Bad")
+    with pytest.raises(ValueError):
+        h.create_index("9start")
+    idx = h.create_index("ok")
+    with pytest.raises(Exception):
+        idx.create_field("_reserved")
+
+
+def test_existence_field_tracks_columns():
+    h = Holder(None)
+    idx = h.create_index("i")
+    assert EXISTENCE_FIELD_NAME in idx.fields
+    idx.add_existence(np.array([1, 2, SHARD_WIDTH + 3]))
+    segs = idx.existence_row()
+    assert set(bitset.unpack_columns(segs[0]).tolist()) == {1, 2}
+    assert set(bitset.unpack_columns(segs[1]).tolist()) == {3}
+
+
+# -- time quantum (time_internal_test.go mirror) ----------------------------
+
+def test_views_by_time():
+    ts = datetime(2017, 3, 20, 10)
+    assert tq.views_by_time("std", ts, "YMDH") == [
+        "std_2017", "std_201703", "std_20170320", "std_2017032010"]
+
+
+def test_views_by_time_range_ymdh():
+    # mirrors time_internal_test.go TestViewsByTimeRange
+    got = tq.views_by_time_range(
+        "F", datetime(2016, 12, 30, 22), datetime(2017, 1, 2, 8), "YMDH")
+    assert got == [
+        "F_2016123022", "F_2016123023", "F_20161231",
+        "F_20170101", "F_2017010200", "F_2017010201", "F_2017010202",
+        "F_2017010203", "F_2017010204", "F_2017010205", "F_2017010206",
+        "F_2017010207"]
+
+
+def test_views_by_time_range_y():
+    got = tq.views_by_time_range(
+        "F", datetime(2015, 1, 1), datetime(2018, 1, 1), "Y")
+    assert got == ["F_2015", "F_2016", "F_2017"]
+
+
+def test_min_max_views():
+    views = ["f_2017", "f_201701", "f_20170101", "f_2016"]
+    lo, hi = tq.min_max_views(views, "YMD")
+    assert (lo, hi) == ("f_2016", "f_2017")
+
+
+def test_quantum_validation():
+    tq.validate_quantum("YMDH")
+    with pytest.raises(tq.InvalidTimeQuantumError):
+        tq.validate_quantum("X")
+    with pytest.raises(tq.InvalidTimeQuantumError):
+        tq.validate_quantum("HY")
