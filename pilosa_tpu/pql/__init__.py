@@ -1,0 +1,6 @@
+"""PQL: query language AST + parser (reference pql/)."""
+
+from .ast import (  # noqa: F401
+    BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ, Query, WRITE_CALLS,
+)
+from .parser import ParseError, parse  # noqa: F401

@@ -1,0 +1,202 @@
+"""PQL parser golden tests — mirrors reference pql/pqlpeg_test.go cases
+(happy path ncalls vectors + structure assertions + error cases)."""
+
+import pytest
+
+from pilosa_tpu import pql
+from pilosa_tpu.pql import BETWEEN, Condition
+
+
+# (input, expected number of top-level calls) — from pqlpeg_test.go:79-303
+HAPPY = [
+    ("", 0),
+    ("Set(2, f=10)", 1),
+    ("Set('foo', f=10)", 1),
+    ('Set("foo", f=10)', 1),
+    ("Set(2, f=1, 1999-12-31T00:00)", 1),
+    ("Set(1, a=4)Set(2, a=4)", 2),
+    ("Set(1, a=4) Set(2, a=4)", 2),
+    ("Set(1, a=4) \n Set(2, a=4)", 2),
+    ("Set(1, a=4)Blerg(z=ha)", 2),
+    ("Set(1, a=4)Blerg(z=ha)Set(2, z=99)", 3),
+    ("Arb(q=1, a=4)Set(1, z=9)Arb(z=99)", 3),
+    ("Set(1, a=zoom)", 1),
+    ("Set(1, a=4, b=5)", 1),
+    ("Set(1, a=4, bsd=haha)", 1),
+    ("Set(1, a=4, 2017-04-03T19:34)", 1),
+    ("Union()", 1),
+    ("Union(Row(a=1))", 1),
+    ("Union(Row(a=1), Row(z=44))", 1),
+    ("Union(Intersect(Row(), Union(Row(), Row())), Row())", 1),
+    ("TopN(boondoggle)", 1),
+    ("TopN(boon, doggle=9)", 1),
+    ('B(a="zm\'\'e")', 1),
+    ("B(a='zm\"\"e')", 1),
+    ("SetRowAttrs(blah, 9, a=47)", 1),
+    ("SetRowAttrs(blah, 9, a=47, b=bval)", 1),
+    ("SetRowAttrs(blah, 'rowKey', a=47)", 1),
+    ('SetRowAttrs(blah, "rowKey", a=47)', 1),
+    ("SetColumnAttrs(9, a=47)", 1),
+    ("SetColumnAttrs(9, a=47, b=bval)", 1),
+    ("SetColumnAttrs('colKey', a=47)", 1),
+    ('SetColumnAttrs("colKey", a=47)', 1),
+    ("Clear(1, a=53)", 1),
+    ("Clear(1, a=53, b=33)", 1),
+    ("TopN(myfield, n=44)", 1),
+    ("TopN(myfield, Row(a=47), n=10)", 1),
+    ("Row(a < 4)", 1),
+    ("Row(a > 4)", 1),
+    ("Row(a <= 4)", 1),
+    ("Row(a >= 4)", 1),
+    ("Row(a == 4)", 1),
+    ("Row(a != null)", 1),
+    ("Row(4 < a < 9)", 1),
+    ("Row(4 < a <= 9)", 1),
+    ("Row(4 <= a < 9)", 1),
+    ("Row(4 <= a <= 9)", 1),
+    ("Row(a=4, from=2010-07-04T00:00, to=2010-08-04T00:00)", 1),
+    ("Row(a=4, from='2010-07-04T00:00', to=\"2010-08-04T00:00\")", 1),
+    ("Row(a=4, from='2010-07-04T00:00')", 1),
+    ("Row(a=4, to=\"2010-08-04T00:00\")", 1),
+    ("Set(1, my-frame=9)", 1),
+    ("Set(\n1,\nmy-frame\n=9)", 1),
+    ("Range(blah=1, 2019-04-07T00:00, 2019-08-07T00:00)", 1),
+]
+
+
+@pytest.mark.parametrize("text,ncalls", HAPPY)
+def test_parse_happy(text, ncalls):
+    q = pql.parse(text)
+    assert len(q.calls) == ncalls, repr(q)
+
+
+# error cases (pqlpeg_test.go:304-341 TestPEGErrors) + extras
+BAD = [
+    "Set",
+    "Set(1, a=4, 2017-94-03T19:34)",
+    "Set(1, 2017-04-03T19:34)",
+    "Set(, 1, a=4)",
+    "Zeeb(, a=4)",
+    "SetRowAttrs(blah, 9)",
+    "Clear(9)",
+    "Row(a>4, 2010-07-04T00:00, 2010-08-04T00:00)",
+    "Row(a=4, 2010-07-04T00:00)",
+    "Row(a=9223372036854775808)",
+    "Row(a=-9223372036854775809)",
+    "Set()haha",
+    "Set(1, a=4)'",
+    "Set(a=4)",
+    "Set(1, b=5",
+    ", Blerg()",
+    "SetRowAttrs(blah)",
+    "Clear()",
+]
+
+
+@pytest.mark.parametrize("text", BAD)
+def test_parse_errors(text):
+    with pytest.raises(pql.ParseError):
+        pql.parse(text)
+
+
+# -- structural assertions --------------------------------------------------
+
+def test_set_structure():
+    q = pql.parse("Set(2, f=10, 1999-12-31T00:00)")
+    c = q.calls[0]
+    assert c.name == "Set"
+    assert c.args["_col"] == 2
+    assert c.args["f"] == 10
+    assert c.args["_timestamp"] == "1999-12-31T00:00"
+
+
+def test_nested_structure():
+    q = pql.parse("Intersect(Row(a=1), Union(Row(b=2), Row(c=3)), x=7)")
+    c = q.calls[0]
+    assert c.name == "Intersect"
+    assert [ch.name for ch in c.children] == ["Row", "Union"]
+    assert c.children[1].children[0].args["b"] == 2
+    assert c.args["x"] == 7
+
+
+def test_condition_structure():
+    q = pql.parse("Row(a <= 4)")
+    cond = q.calls[0].args["a"]
+    assert isinstance(cond, Condition)
+    assert cond.op == "<="
+    assert cond.value == 4
+
+
+def test_between_adjusts_strict_bounds():
+    q = pql.parse("Row(4 < a <= 9)")
+    cond = q.calls[0].args["a"]
+    assert cond.op == BETWEEN
+    assert cond.value == [5, 9]
+    q = pql.parse("Row(4 <= a < 9)")
+    assert q.calls[0].args["a"].value == [4, 8]
+
+
+def test_topn_posfield():
+    q = pql.parse("TopN(myfield, Row(a=47), n=10)")
+    c = q.calls[0]
+    assert c.args["_field"] == "myfield"
+    assert c.children[0].name == "Row"
+    assert c.args["n"] == 10
+
+
+def test_store_structure():
+    q = pql.parse("Store(Row(a=1), b=2)")
+    c = q.calls[0]
+    assert c.name == "Store"
+    assert c.children[0].name == "Row"
+    assert c.args["b"] == 2
+
+
+def test_rows_args():
+    q = pql.parse("Rows(f, previous=10, limit=5, column=3)")
+    c = q.calls[0]
+    assert c.args["_field"] == "f"
+    assert c.args["previous"] == 10
+    assert c.args["limit"] == 5
+
+
+def test_value_forms():
+    q = pql.parse(
+        'F(a=null, b=true, c=false, d=-5, e=1.25, f=word, g="q s", '
+        "h=[1,2,3], i=a:b-c_d)")
+    a = q.calls[0].args
+    assert a["a"] is None
+    assert a["b"] is True
+    assert a["c"] is False
+    assert a["d"] == -5
+    assert a["e"] == 1.25
+    assert a["f"] == "word"
+    assert a["g"] == "q s"
+    assert a["h"] == [1, 2, 3]
+    assert a["i"] == "a:b-c_d"
+
+
+def test_quoted_string_escapes():
+    q = pql.parse(r'F(a="x\"y", b=\'p\\\'q\')'.replace(r"\'", "'")
+                  if False else 'F(a="x\\"y")')
+    assert q.calls[0].args["a"] == 'x"y'
+
+
+def test_clearrow_and_range_call():
+    q = pql.parse("ClearRow(f=5)")
+    assert q.calls[0].args["f"] == 5
+    q = pql.parse("Range(blah=1, 2019-04-07T00:00, 2019-08-07T00:00)")
+    c = q.calls[0]
+    assert c.args["blah"] == 1
+    assert c.args["from"] == "2019-04-07T00:00"
+    assert c.args["to"] == "2019-08-07T00:00"
+
+
+def test_write_calls_detection():
+    q = pql.parse("Set(1, a=2)Count(Row(a=2))")
+    assert [c.name for c in q.write_calls()] == ["Set"]
+
+
+def test_duplicate_arg_rejected():
+    with pytest.raises(pql.ParseError):
+        pql.parse("Row(a=1, a=2)")
