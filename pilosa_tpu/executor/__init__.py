@@ -1,0 +1,7 @@
+"""Query executor: plan compiler + call dispatch (reference executor.go)."""
+
+from .executor import ExecutionError, Executor  # noqa: F401
+from .plan import PlanError  # noqa: F401
+from .results import (  # noqa: F401
+    FieldRow, GroupCount, Pair, RowIdentifiers, RowResult, ValCount,
+)

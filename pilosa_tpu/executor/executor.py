@@ -1,0 +1,510 @@
+"""Executor: recursive PQL call dispatch over shards (executor.go:44-339).
+
+The reference fans per-shard work out to a goroutine pool and reduces
+streamed results (executor.go:2455 mapReduce).  Here each shard's bitmap
+work is one cached XLA computation (see plan.py); shards are dispatched
+asynchronously (jax queues them) and reduced on host.  Aggregations ship
+only scalars/count-vectors back from the device.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any
+
+import numpy as np
+
+from ..core import SHARD_WIDTH, VIEW_STANDARD
+from ..ops import bitset, bsi
+from ..pql import Call, Query, parse
+from ..storage.field import FIELD_TYPE_INT, FIELD_TYPE_BOOL
+from ..storage import time_quantum as tq
+from .plan import PlanCompiler, PlanError, Resolver
+from .results import (
+    FieldRow, GroupCount, Pair, RowIdentifiers, RowResult, ValCount,
+    merge_pairs, sort_pairs,
+)
+
+BITMAP_CALLS = {"Row", "Range", "Intersect", "Union", "Difference", "Xor",
+                "Not", "Shift"}
+
+
+class ExecutionError(ValueError):
+    pass
+
+
+class Executor:
+    def __init__(self, holder):
+        self.holder = holder
+        self.compiler = PlanCompiler()
+
+    # -- entry point (executor.go:113 Execute) -----------------------------
+
+    def execute(self, index_name: str, query, shards=None) -> list[Any]:
+        if isinstance(query, str):
+            query = parse(query)
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise ExecutionError(f"index not found: {index_name}")
+        if shards is None:
+            shards = sorted(idx.available_shards())
+        return [self._execute_call(index_name, c, shards)
+                for c in query.calls]
+
+    # -- dispatch (executor.go:274 executeCall) ----------------------------
+
+    def _execute_call(self, index: str, c: Call, shards: list[int]):
+        name = c.name
+        if name == "Count":
+            return self._execute_count(index, c, shards)
+        if name == "Sum":
+            return self._execute_sum(index, c, shards)
+        if name in ("Min", "Max"):
+            return self._execute_min_max(index, c, shards, name == "Max")
+        if name in ("MinRow", "MaxRow"):
+            return self._execute_min_max_row(index, c, shards, name == "MaxRow")
+        if name == "TopN":
+            return self._execute_topn(index, c, shards)
+        if name == "Rows":
+            return self._execute_rows(index, c, shards)
+        if name == "GroupBy":
+            return self._execute_group_by(index, c, shards)
+        if name == "Options":
+            return self._execute_options(index, c, shards)
+        if name == "Set":
+            return self._execute_set(index, c)
+        if name == "Clear":
+            return self._execute_clear(index, c)
+        if name == "ClearRow":
+            return self._execute_clear_row(index, c, shards)
+        if name == "Store":
+            return self._execute_store(index, c, shards)
+        if name in ("SetRowAttrs", "SetColumnAttrs"):
+            return self._execute_set_attrs(index, c)
+        if name in BITMAP_CALLS:
+            return self._execute_bitmap(index, c, shards)
+        raise ExecutionError(f"unknown call: {name}")
+
+    # -- bitmap calls ------------------------------------------------------
+
+    def _resolve(self, index: str, c: Call):
+        return Resolver(self.holder, index).resolve_bitmap(c)
+
+    def _execute_bitmap(self, index: str, c: Call, shards) -> RowResult:
+        plan = self._resolve(index, c)
+        segments = {}
+        for shard in shards:
+            segments[shard] = self.compiler.execute_shard(
+                plan, self.holder, index, shard)
+        return RowResult(segments)
+
+    # -- aggregations ------------------------------------------------------
+
+    def _execute_count(self, index: str, c: Call, shards) -> int:
+        """(executor.go:1790 executeCount)"""
+        if len(c.children) != 1:
+            raise ExecutionError("Count() requires one input")
+        plan = self._resolve(index, c.children[0])
+        counts = [
+            self.compiler.execute_shard(plan, self.holder, index, shard,
+                                        reducer="count")
+            for shard in shards
+        ]
+        return sum(int(x) for x in counts)
+
+    def _bsi_field(self, index: str, c: Call):
+        field_name, _ = c.string_arg("field")
+        if not field_name:
+            fa = c.field_arg()
+            if fa is None:
+                raise ExecutionError("field required")
+            field_name = fa[0]
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        if f.options.type != FIELD_TYPE_INT:
+            raise ExecutionError(f"field {field_name!r} is not an int field")
+        return f
+
+    def _filter_segments(self, index: str, c: Call, shards):
+        """Evaluate the optional filter child of Sum/Min/Max/TopN."""
+        if not c.children:
+            return None
+        plan = self._resolve(index, c.children[0])
+        return {
+            shard: self.compiler.execute_shard(plan, self.holder, index,
+                                               shard)
+            for shard in shards
+        }
+
+    def _execute_sum(self, index: str, c: Call, shards) -> ValCount:
+        """(executor.go:406 executeSum + fragment.go:1111 sum)"""
+        f = self._bsi_field(index, c)
+        filters = self._filter_segments(index, c, shards)
+        view = f.bsi_view_name()
+        total, n = 0, 0
+        for shard in shards:
+            frag = self.holder.fragment(index, f.name, view, shard)
+            if frag is None or frag.n_rows < bsi.OFFSET_ROW + 1:
+                continue
+            filt = None if filters is None else filters.get(shard)
+            counts = np.asarray(bsi.sum_counts(frag.device(), filt))
+            s, cnt = bsi.weighted_sum(counts)
+            total += s
+            n += cnt
+        # values are stored base-offset: add base per set column
+        # (field.go:1138 Sum: sum + count*base)
+        return ValCount(total + n * f.options.base, n)
+
+    def _execute_min_max(self, index: str, c: Call, shards,
+                         want_max: bool) -> ValCount:
+        """(executor.go:437 executeMin/:472 executeMax)"""
+        f = self._bsi_field(index, c)
+        filters = self._filter_segments(index, c, shards)
+        view = f.bsi_view_name()
+        acc = ValCount()
+        for shard in shards:
+            frag = self.holder.fragment(index, f.name, view, shard)
+            if frag is None or frag.n_rows < bsi.OFFSET_ROW + 1:
+                continue
+            filt = None if filters is None else filters.get(shard)
+            bits, neg, cnt = bsi.min_max_bits(frag.device(), filt,
+                                              want_max=want_max)
+            val, cnt = bsi.reconstruct_min_max(
+                np.asarray(bits), int(neg), int(cnt))
+            vc = ValCount(val + f.options.base if cnt else 0, cnt)
+            acc = acc.larger(vc) if want_max else acc.smaller(vc)
+        return acc
+
+    def _execute_min_max_row(self, index: str, c: Call, shards,
+                             want_max: bool) -> ValCount:
+        """MinRow/MaxRow: extreme row id with any bit set
+        (executor.go:506 executeMinRow)."""
+        field_name, ok = c.string_arg("field")
+        if not ok:
+            raise ExecutionError(f"{c.name}(): field required")
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        best, best_count = None, 0
+        v = f.view(VIEW_STANDARD)
+        for shard in shards:
+            frag = None if v is None else v.fragment(shard)
+            if frag is None or frag.n_rows == 0:
+                continue
+            counts = np.asarray(bitset.row_counts(frag.device()))
+            nz = np.nonzero(counts)[0]
+            if nz.size == 0:
+                continue
+            rid = int(nz[-1] if want_max else nz[0])
+            if best is None or (rid > best if want_max else rid < best):
+                best, best_count = rid, int(counts[rid])
+            elif rid == best:
+                best_count += int(counts[rid])
+        return ValCount(best or 0, best_count if best is not None else 0)
+
+    # -- TopN (executor.go:860 executeTopN, fragment.go:1570 top) ----------
+
+    def _execute_topn(self, index: str, c: Call, shards) -> list[Pair]:
+        field_name, ok = c.string_arg("_field")
+        if not ok:
+            raise ExecutionError("TopN() requires a field")
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        n, _ = c.uint_arg("n")
+        ids = c.args.get("ids")
+        filters = self._filter_segments(index, c, shards)
+
+        v = f.view(VIEW_STANDARD)
+        per_shard: list[list[Pair]] = []
+        for shard in shards:
+            frag = None if v is None else v.fragment(shard)
+            if frag is None or frag.n_rows == 0:
+                continue
+            dev = frag.device()
+            filt = None if filters is None else filters.get(shard)
+            if filt is not None:
+                counts_dev = bitset.row_counts(
+                    bitset.intersect(dev, filt[None, :]))
+            else:
+                counts_dev = bitset.row_counts(dev)
+            counts = np.asarray(counts_dev)
+            if ids:
+                sel = [i for i in ids if i < counts.size]
+                per_shard.append(
+                    [Pair(int(i), int(counts[i])) for i in sel])
+            else:
+                nz = np.nonzero(counts)[0]
+                per_shard.append(
+                    [Pair(int(i), int(counts[i])) for i in nz])
+        pairs = merge_pairs(per_shard)
+        pairs = [p for p in pairs if p.count > 0]
+        return sort_pairs(pairs, n or None)
+
+    # -- Rows (executor.go:1274 executeRows) -------------------------------
+
+    def _execute_rows(self, index: str, c: Call, shards) -> RowIdentifiers:
+        field_name, ok = c.string_arg("_field")
+        if not ok:
+            raise ExecutionError("Rows() requires a field")
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        limit = c.args.get("limit")
+        previous = c.args.get("previous")
+        column = c.args.get("column")
+
+        views = [VIEW_STANDARD]
+        from_arg, to_arg = c.args.get("from"), c.args.get("to")
+        if from_arg or to_arg:
+            quantum = f.options.time_quantum
+            if not quantum:
+                raise ExecutionError(
+                    f"field {field_name!r} has no time quantum")
+            from_time = tq.parse_time(from_arg) if from_arg \
+                else datetime(1, 1, 1)
+            to_time = tq.parse_time(to_arg) if to_arg else datetime(9999, 1, 1)
+            views = tq.views_by_time_range(VIEW_STANDARD, from_time, to_time,
+                                           quantum)
+
+        row_ids: set[int] = set()
+        for vname in views:
+            v = f.view(vname)
+            if v is None:
+                continue
+            for shard in shards:
+                if column is not None and column // SHARD_WIDTH != shard:
+                    continue
+                frag = v.fragment(shard)
+                if frag is None or frag.n_rows == 0:
+                    continue
+                dev = frag.device()
+                if column is not None:
+                    col_local = column % SHARD_WIDTH
+                    w, bit = bitset.word_bit_np(col_local)
+                    present = np.asarray(dev[:, w]) & bit > 0
+                    ids = np.nonzero(present)[0]
+                else:
+                    counts = np.asarray(bitset.row_counts(dev))
+                    ids = np.nonzero(counts)[0]
+                row_ids.update(int(i) for i in ids)
+
+        out = sorted(row_ids)
+        if previous is not None:
+            out = [r for r in out if r > previous]
+        if limit is not None:
+            out = out[:limit]
+        return RowIdentifiers(rows=out)
+
+    # -- GroupBy (executor.go:1068 executeGroupBy) -------------------------
+
+    def _execute_group_by(self, index: str, c: Call,
+                          shards) -> list[GroupCount]:
+        if not c.children:
+            raise ExecutionError("GroupBy requires at least one Rows() child")
+        limit = c.args.get("limit")
+        filt_call = None
+        rows_calls = []
+        for ch in c.children:
+            if ch.name == "Rows":
+                rows_calls.append(ch)
+            else:
+                filt_call = ch
+        if not rows_calls:
+            raise ExecutionError("GroupBy requires Rows() children")
+
+        fields = []
+        for rc in rows_calls:
+            fname, ok = rc.string_arg("_field")
+            if not ok:
+                raise ExecutionError("Rows() requires a field")
+            ids = self._execute_rows(index, rc, shards).rows
+            fields.append((fname, ids))
+
+        filter_segs = None
+        if filt_call is not None:
+            plan = self._resolve(index, filt_call)
+            filter_segs = {
+                s: self.compiler.execute_shard(plan, self.holder, index, s)
+                for s in shards
+            }
+
+        # Count each combination: per shard, AND the group rows' segments +
+        # optional filter, popcount.  The innermost field is batched on
+        # device via intersection_counts_matrix when the group prefix is a
+        # single segment (the common case).
+        results: list[GroupCount] = []
+        last_field, last_ids = fields[-1]
+        prefix_fields = fields[:-1]
+
+        def prefix_combos(i=0, combo=()):
+            if i == len(prefix_fields):
+                yield combo
+                return
+            fname, ids = prefix_fields[i]
+            for rid in ids:
+                yield from prefix_combos(i + 1, combo + ((fname, rid),))
+
+        last_pos = {r: j for j, r in enumerate(last_ids)}
+        for combo in prefix_combos():
+            counts_acc = np.zeros(len(last_ids), dtype=np.int64)
+            for shard in shards:
+                prefix_seg = None
+                empty = False
+                for fname, rid in combo:
+                    frag = self.holder.fragment(index, fname, VIEW_STANDARD,
+                                                shard)
+                    if frag is None or rid >= frag.n_rows:
+                        empty = True
+                        break
+                    seg = frag.device()[rid]
+                    prefix_seg = seg if prefix_seg is None else \
+                        bitset.intersect(prefix_seg, seg)
+                if empty:
+                    continue
+                if filter_segs is not None:
+                    fseg = filter_segs[shard]
+                    prefix_seg = fseg if prefix_seg is None else \
+                        bitset.intersect(prefix_seg, fseg)
+                frag = self.holder.fragment(index, last_field, VIEW_STANDARD,
+                                            shard)
+                if frag is None or frag.n_rows == 0:
+                    continue
+                dev = frag.device()
+                valid = [r for r in last_ids if r < frag.n_rows]
+                if not valid:
+                    continue
+                sel = dev[np.array(valid)]
+                if prefix_seg is None:
+                    cnts = np.asarray(bitset.row_counts(sel))
+                else:
+                    cnts = np.asarray(bitset.row_counts(
+                        bitset.intersect(sel, prefix_seg[None, :])))
+                for j, r in enumerate(valid):
+                    counts_acc[last_pos[r]] += int(cnts[j])
+            for j, rid in enumerate(last_ids):
+                if counts_acc[j] > 0:
+                    group = [FieldRow(fn, ri) for fn, ri in combo]
+                    group.append(FieldRow(last_field, rid))
+                    results.append(GroupCount(group, int(counts_acc[j])))
+
+        results.sort(key=lambda g: tuple(
+            (fr.field, fr.row_id) for fr in g.group))
+        if limit is not None:
+            results = results[:limit]
+        return results
+
+    # -- Options (executor.go executeOptionsCall) --------------------------
+
+    def _execute_options(self, index: str, c: Call, shards):
+        if len(c.children) != 1:
+            raise ExecutionError("Options() requires exactly one child")
+        if "shards" in c.args:
+            arg = c.args["shards"]
+            if not isinstance(arg, list):
+                raise ExecutionError("Options() shards must be a list")
+            shards = [int(s) for s in arg]
+        return self._execute_call(index, c.children[0], shards)
+
+    # -- writes (executor.go:2067 executeSet etc.) -------------------------
+
+    def _require_col(self, c: Call) -> int:
+        col = c.args.get("_col")
+        if not isinstance(col, int) or isinstance(col, bool):
+            raise ExecutionError(
+                f"{c.name}() column argument must be an integer id "
+                f"(got {col!r})")
+        return col
+
+    def _execute_set(self, index: str, c: Call) -> bool:
+        idx = self.holder.index(index)
+        col = self._require_col(c)
+        fa = c.field_arg()
+        if fa is None:
+            raise ExecutionError("Set() requires a field=<row> argument")
+        field_name, row_val = fa
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+
+        if f.options.type == FIELD_TYPE_INT:
+            if not isinstance(row_val, int):
+                raise ExecutionError("Set() int field requires integer value")
+            changed = f.set_value(col, row_val)
+        else:
+            ts = None
+            if "_timestamp" in c.args:
+                ts = tq.parse_time(c.args["_timestamp"])
+            row_val = self._coerce_row(f, row_val)
+            changed = f.set_bit(row_val, col, ts=ts)
+        idx.add_existence(np.array([col]))
+        return changed
+
+    @staticmethod
+    def _coerce_row(f, row_val) -> int:
+        if isinstance(row_val, bool):
+            if f.options.type != FIELD_TYPE_BOOL:
+                raise ExecutionError("bool row value on non-bool field")
+            return int(row_val)
+        if not isinstance(row_val, int):
+            raise ExecutionError(
+                f"row must be an integer id, got {row_val!r}")
+        return row_val
+
+    def _execute_clear(self, index: str, c: Call) -> bool:
+        col = self._require_col(c)
+        fa = c.field_arg()
+        if fa is None:
+            raise ExecutionError("Clear() requires a field=<row> argument")
+        field_name, row_val = fa
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        return f.clear_bit(self._coerce_row(f, row_val), col)
+
+    def _execute_clear_row(self, index: str, c: Call, shards) -> bool:
+        """(executor.go:1825 executeClearRow)"""
+        fa = c.field_arg()
+        if fa is None:
+            raise ExecutionError("ClearRow() requires a field=<row> argument")
+        field_name, row_id = fa
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        changed = False
+        for vname, v in list(f.views.items()):
+            if vname.startswith("bsig_"):
+                continue
+            for shard in shards:
+                frag = v.fragment(shard)
+                if frag is not None and row_id < frag.n_rows:
+                    if frag.row(row_id).any():
+                        frag.set_row(row_id, None)
+                        changed = True
+        return changed
+
+    def _execute_store(self, index: str, c: Call, shards) -> bool:
+        """Store(Row(...), field=row) (executor.go:1979 executeSetRow)"""
+        fa = c.field_arg()
+        if fa is None:
+            raise ExecutionError("Store() requires a field=<row> argument")
+        field_name, row_id = fa
+        f = self.holder.field(index, field_name)
+        if f is None:
+            f = self.holder.index(index).create_field_if_not_exists(field_name)
+        if len(c.children) != 1:
+            raise ExecutionError("Store() requires exactly one input row")
+        src = self._execute_bitmap(index, c.children[0], shards)
+        for shard in shards:
+            seg = src.segments.get(shard)
+            v = f._create_view_if_not_exists(VIEW_STANDARD)
+            frag = v.create_fragment_if_not_exists(shard)
+            frag.set_row(row_id, None if seg is None else np.asarray(seg))
+        return True
+
+    def _execute_set_attrs(self, index: str, c: Call):
+        # Attribute storage arrives with the attrs subsystem (storage/attrs);
+        # wired in the API layer.
+        from ..storage.attrs import set_attrs_from_call
+        return set_attrs_from_call(self.holder, index, c)

@@ -1,0 +1,370 @@
+"""Bitmap-call plan IR and the per-shard XLA compiler.
+
+The reference executes call trees interpretively, one roaring op at a time
+(executor.go:651 executeBitmapCallShard).  Here a PQL bitmap call tree is
+first *resolved* against the schema into a static plan IR — field/view lookup,
+BSI base-value computation (field.go:1574 baseValue), time-range view
+expansion (executor.go:1441 executeRowShard) — and the IR is then compiled to
+ONE jitted XLA computation per (plan, input-shapes) signature, cached.  A
+query like Count(Intersect(Row, Row, Not(Row))) runs as a single fused kernel
+per shard: every AND/OR/popcount collapses into one pass over HBM.
+
+Plan node types double as cache keys via their repr.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from datetime import datetime, timedelta
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import SHARD_WORDS, VIEW_STANDARD
+from ..ops import bitset, bsi
+from ..pql import BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ
+from ..storage.field import FIELD_TYPE_INT, Field
+from ..storage import time_quantum as tq
+
+
+class PlanError(ValueError):
+    pass
+
+
+# -- plan IR ---------------------------------------------------------------
+
+@dataclass(frozen=True)
+class RowPlan:
+    """Row(field=id) over one or more views (standard or time views)."""
+    field: str
+    views: tuple[str, ...]
+    row_id: int
+
+
+@dataclass(frozen=True)
+class BSIPlan:
+    """Row(field <op> value) against a bsig_ view.  op in bsi.range_op's
+    vocabulary, plus "notnull" and "empty" specials."""
+    field: str
+    view: str
+    op: str                  # eq|neq|lt|le|gt|ge|between|notnull|empty
+    value: int = 0
+    value2: int = 0          # between upper bound
+
+
+@dataclass(frozen=True)
+class NotPlan:
+    existence: "RowPlan"
+    child: Any
+
+
+@dataclass(frozen=True)
+class ShiftPlan:
+    child: Any
+    n: int
+
+
+@dataclass(frozen=True)
+class NaryPlan:
+    op: str                  # intersect|union|difference|xor
+    children: tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class ConstPlan:
+    """All-zero segment."""
+
+
+# -- resolution: pql.Call -> plan IR ---------------------------------------
+
+class Resolver:
+    """Resolves bitmap calls against a holder's schema (host-side, once per
+    query)."""
+
+    def __init__(self, holder, index_name: str):
+        self.holder = holder
+        self.index = holder.index(index_name)
+        if self.index is None:
+            raise PlanError(f"index not found: {index_name}")
+        self.index_name = index_name
+
+    def field(self, name: str) -> Field:
+        f = self.index.field(name)
+        if f is None:
+            raise PlanError(f"field not found: {name}")
+        return f
+
+    def resolve_bitmap(self, c: Call):
+        name = c.name
+        if name in ("Row", "Range"):
+            return self._resolve_row(c)
+        if name == "Intersect":
+            if not c.children:
+                raise PlanError("empty Intersect query is currently not "
+                                "supported")
+            return NaryPlan("intersect", tuple(
+                self.resolve_bitmap(ch) for ch in c.children))
+        if name == "Union":
+            return NaryPlan("union", tuple(
+                self.resolve_bitmap(ch) for ch in c.children))
+        if name == "Difference":
+            return NaryPlan("difference", tuple(
+                self.resolve_bitmap(ch) for ch in c.children))
+        if name == "Xor":
+            return NaryPlan("xor", tuple(
+                self.resolve_bitmap(ch) for ch in c.children))
+        if name == "Not":
+            if not self.index.track_existence:
+                raise PlanError(
+                    "Not() query requires existence tracking to be enabled "
+                    "on the index")
+            if len(c.children) != 1:
+                raise PlanError("Not() requires exactly one input row")
+            from ..core import EXISTENCE_FIELD_NAME
+            return NotPlan(
+                RowPlan(EXISTENCE_FIELD_NAME, (VIEW_STANDARD,), 0),
+                self.resolve_bitmap(c.children[0]))
+        if name == "Shift":
+            # n defaults to 0 = identity (executor.go:1770, row.go:220)
+            n, _ = c.uint_arg("n")
+            if len(c.children) != 1:
+                raise PlanError("Shift() requires exactly one input row")
+            child = self.resolve_bitmap(c.children[0])
+            return child if n == 0 else ShiftPlan(child, n)
+        raise PlanError(f"unknown bitmap call: {name}")
+
+    def _resolve_row(self, c: Call):
+        # BSI condition form: Row(field <op> value)
+        cond_arg = c.condition_arg()
+        if cond_arg is not None:
+            if len(c.args) > 1:
+                raise PlanError("Row(): too many arguments")
+            return self._resolve_bsi(*cond_arg)
+
+        fa = c.field_arg()
+        if fa is None:
+            raise PlanError("Row() argument required: field")
+        field_name, row_id = fa
+        f = self.field(field_name)
+        if not isinstance(row_id, int) or isinstance(row_id, bool):
+            raise PlanError(f"Row() row id must be an integer, got "
+                            f"{row_id!r} (key translation requires keys "
+                            f"support)")
+
+        from_arg = c.args.get("from") or c.args.get("_start")
+        to_arg = c.args.get("to") or c.args.get("_end")
+        if c.name == "Row" and from_arg is None and to_arg is None:
+            return RowPlan(field_name, (VIEW_STANDARD,), row_id)
+
+        quantum = f.options.time_quantum
+        if not quantum:
+            return ConstPlan()
+        from_time = tq.parse_time(from_arg) if from_arg else datetime(1, 1, 1)
+        if to_arg:
+            to_time = tq.parse_time(to_arg)
+        else:
+            # executor.go:1506: now + 1 day when "to" omitted
+            to_time = datetime.utcnow() + timedelta(days=1)
+        views = tuple(tq.views_by_time_range(
+            VIEW_STANDARD, from_time, to_time, quantum))
+        if not views:
+            return ConstPlan()
+        return RowPlan(field_name, views, row_id)
+
+    def _resolve_bsi(self, field_name: str, cond: Condition):
+        """(executor.go:1533 executeRowBSIGroupShard + field.go:1574
+        baseValue)"""
+        f = self.field(field_name)
+        if f.options.type != FIELD_TYPE_INT:
+            raise PlanError(f"field {field_name!r} is not an int field")
+        view = f.bsi_view_name()
+        base = f.options.base
+        depth = f.options.bit_depth
+        vmin = base - (1 << depth) + 1  # bitDepthMin (field.go:1638)
+        vmax = base + (1 << depth) - 1  # bitDepthMax
+
+        if cond.op == NEQ and cond.value is None:
+            return BSIPlan(field_name, view, "notnull")
+        if cond.op == BETWEEN:
+            lo, hi = cond.value
+            if hi < vmin or lo > vmax:
+                return BSIPlan(field_name, view, "empty")
+            if lo <= f.options.min and hi >= f.options.max:
+                return BSIPlan(field_name, view, "notnull")
+            lo_b = max(lo, vmin) - base
+            hi_b = min(hi, vmax) - base
+            return BSIPlan(field_name, view, "between", lo_b, hi_b)
+
+        value = cond.value
+        if not isinstance(value, int) or isinstance(value, bool):
+            raise PlanError("Row(): conditions only support integer values")
+
+        # full-encompass fast paths -> notNull (executor.go:1650)
+        if (cond.op == LT and value > f.options.max) or \
+           (cond.op == LTE and value >= f.options.max) or \
+           (cond.op == GT and value < f.options.min) or \
+           (cond.op == GTE and value <= f.options.min):
+            return BSIPlan(field_name, view, "notnull")
+
+        # baseValue with out-of-range handling (field.go:1574)
+        out_of_range = False
+        base_value = 0
+        if cond.op in (GT, GTE):
+            if value > vmax:
+                out_of_range = True
+            elif value > vmin:
+                base_value = value - base
+            else:
+                base_value = vmin - base
+        elif cond.op in (LT, LTE):
+            if value < vmin:
+                out_of_range = True
+            elif value > vmax:
+                base_value = vmax - base
+            else:
+                base_value = value - base
+        else:  # EQ / NEQ
+            if value < vmin or value > vmax:
+                out_of_range = True
+            else:
+                base_value = value - base
+
+        if out_of_range:
+            if cond.op == NEQ:
+                return BSIPlan(field_name, view, "notnull")
+            return BSIPlan(field_name, view, "empty")
+
+        op_map = {EQ: "eq", NEQ: "neq", LT: "lt", LTE: "le", GT: "gt",
+                  GTE: "ge"}
+        return BSIPlan(field_name, view, op_map[cond.op], base_value)
+
+
+# -- compilation: plan IR -> jitted per-shard function ---------------------
+
+def plan_inputs(plan) -> list[tuple[str, str]]:
+    """Deterministic list of (field, view) fragment references of a plan."""
+    out: list[tuple[str, str]] = []
+
+    def walk(p):
+        if isinstance(p, RowPlan):
+            for v in p.views:
+                key = (p.field, v)
+                if key not in out:
+                    out.append(key)
+        elif isinstance(p, BSIPlan):
+            if (p.field, p.view) not in out:
+                out.append((p.field, p.view))
+        elif isinstance(p, NotPlan):
+            walk(p.existence)
+            walk(p.child)
+        elif isinstance(p, ShiftPlan):
+            walk(p.child)
+        elif isinstance(p, NaryPlan):
+            for ch in p.children:
+                walk(ch)
+
+    walk(plan)
+    return out
+
+
+def eval_plan(plan, frags: dict[tuple[str, str], Any]):
+    """Trace a plan over fragment tensors.  ``frags`` maps (field, view) to a
+    uint32[n_rows, W] array or None (missing fragment).  Returns uint32[W]."""
+
+    def zero():
+        return jnp.zeros(SHARD_WORDS, dtype=jnp.uint32)
+
+    def get_row(field, view, row_id):
+        frag = frags.get((field, view))
+        if frag is None or row_id >= frag.shape[0]:
+            return None
+        return frag[row_id]
+
+    def ev(p):
+        if isinstance(p, ConstPlan):
+            return zero()
+        if isinstance(p, RowPlan):
+            segs = [s for v in p.views
+                    if (s := get_row(p.field, v, p.row_id)) is not None]
+            if not segs:
+                return zero()
+            if len(segs) == 1:
+                return segs[0]
+            return bitset.union_many(jnp.stack(segs))
+        if isinstance(p, BSIPlan):
+            frag = frags.get((p.field, p.view))
+            if frag is None or p.op == "empty":
+                return zero()
+            if p.op == "notnull":
+                return bsi.not_null(frag)
+            if p.op == "between":
+                return bsi.range_between(frag, p.value, p.value2)
+            return bsi.range_op(frag, p.op, p.value)
+        if isinstance(p, NotPlan):
+            ex = ev(p.existence)
+            return bitset.difference(ex, ev(p.child))
+        if isinstance(p, ShiftPlan):
+            return bitset.shift(ev(p.child), p.n)
+        if isinstance(p, NaryPlan):
+            segs = [ev(ch) for ch in p.children]
+            if not segs:
+                return zero()
+            acc = segs[0]
+            for s in segs[1:]:
+                if p.op == "intersect":
+                    acc = bitset.intersect(acc, s)
+                elif p.op == "union":
+                    acc = bitset.union(acc, s)
+                elif p.op == "difference":
+                    acc = bitset.difference(acc, s)
+                else:
+                    acc = bitset.xor(acc, s)
+            return acc
+        raise PlanError(f"unknown plan node: {p!r}")
+
+    return ev(plan)
+
+
+class PlanCompiler:
+    """Caches jitted executables keyed by (plan repr, reducer, input shape
+    signature) — the "one XLA computation per request" cache
+    (SURVEY.md §7)."""
+
+    REDUCERS = {
+        None: lambda seg: seg,
+        "count": bitset.count,
+    }
+
+    def __init__(self):
+        self._cache: dict = {}
+
+    def compiled(self, plan, input_keys, shapes, reducer=None):
+        key = (repr(plan), tuple(input_keys), tuple(shapes), reducer)
+        fn = self._cache.get(key)
+        if fn is None:
+            reduce_fn = self.REDUCERS[reducer]
+
+            def run(*arrays):
+                frags = {
+                    k: a for k, a in zip(input_keys, arrays) if a is not None
+                }
+                return reduce_fn(eval_plan(plan, frags))
+
+            fn = jax.jit(run)
+            self._cache[key] = fn
+        return fn
+
+    def execute_shard(self, plan, holder, index_name: str, shard: int,
+                      reducer=None):
+        """Gather device inputs for one shard and run the compiled plan."""
+        keys = plan_inputs(plan)
+        arrays = []
+        for field, view in keys:
+            frag = holder.fragment(index_name, field, view, shard)
+            arrays.append(None if frag is None else frag.device())
+        shapes = tuple(
+            None if a is None else a.shape for a in arrays)
+        fn = self.compiled(plan, keys, shapes, reducer)
+        return fn(*[a for a in arrays])

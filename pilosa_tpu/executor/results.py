@@ -1,0 +1,179 @@
+"""Query result types (reference row.go Row, executor.go ValCount/Pairs/
+GroupCount/RowIdentifiers)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from ..core import SHARD_WIDTH
+from ..ops import bitset
+
+
+class RowResult:
+    """A query-result bitmap: per-shard segments merged late (row.go:26 Row,
+    :332 rowSegment).  Segments stay device-resident (jax arrays) until
+    columns()/count() forces them host-side."""
+
+    def __init__(self, segments: dict[int, Any] | None = None,
+                 keys: list[str] | None = None, attrs: dict | None = None):
+        self.segments = segments or {}   # shard -> uint32[W] (jnp or np)
+        self.keys = keys or []
+        self.attrs = attrs or {}
+
+    # -- algebra (row.go:67-260) ------------------------------------------
+
+    def _binary(self, other: "RowResult", fn, union_domain: bool):
+        out = {}
+        shards = set(self.segments) | set(other.segments) if union_domain \
+            else set(self.segments) & set(other.segments)
+        for s in shards:
+            a = self.segments.get(s)
+            b = other.segments.get(s)
+            if a is None:
+                a = np.zeros_like(np.asarray(b))
+            if b is None:
+                b = np.zeros_like(np.asarray(a))
+            out[s] = fn(a, b)
+        return RowResult(out)
+
+    def intersect(self, other):
+        return self._binary(other, bitset.intersect, union_domain=False)
+
+    def union(self, other):
+        return self._binary(other, bitset.union, union_domain=True)
+
+    def difference(self, other):
+        out = {}
+        for s, a in self.segments.items():
+            b = other.segments.get(s)
+            out[s] = a if b is None else bitset.difference(a, b)
+        return RowResult(out)
+
+    def xor(self, other):
+        return self._binary(other, bitset.xor, union_domain=True)
+
+    # -- materialisation ---------------------------------------------------
+
+    def count(self) -> int:
+        return sum(int(bitset.count(seg)) for seg in self.segments.values())
+
+    def columns(self) -> np.ndarray:
+        """Absolute sorted column ids across shards (row.go Columns)."""
+        parts = []
+        for shard in sorted(self.segments):
+            cols = bitset.unpack_columns(np.asarray(self.segments[shard]))
+            parts.append(cols + shard * SHARD_WIDTH)
+        if not parts:
+            return np.zeros(0, dtype=np.int64)
+        return np.concatenate(parts)
+
+    def shard_counts(self) -> dict[int, int]:
+        return {s: int(bitset.count(seg)) for s, seg in self.segments.items()}
+
+    def is_empty(self) -> bool:
+        return self.count() == 0
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {"columns": self.columns().tolist()}
+        if self.attrs:
+            d["attrs"] = self.attrs
+        if self.keys:
+            d["keys"] = self.keys
+        return d
+
+
+@dataclass
+class ValCount:
+    """Sum/Min/Max result (executor.go:2995 ValCount)."""
+    val: int = 0
+    count: int = 0
+
+    def add(self, other: "ValCount") -> "ValCount":
+        return ValCount(self.val + other.val, self.count + other.count)
+
+    def smaller(self, other: "ValCount") -> "ValCount":
+        if other.count == 0:
+            return self
+        if self.count == 0 or other.val < self.val:
+            return other
+        if other.val == self.val:
+            return ValCount(self.val, self.count + other.count)
+        return self
+
+    def larger(self, other: "ValCount") -> "ValCount":
+        if other.count == 0:
+            return self
+        if self.count == 0 or other.val > self.val:
+            return other
+        if other.val == self.val:
+            return ValCount(self.val, self.count + other.count)
+        return self
+
+    def to_dict(self) -> dict:
+        return {"value": self.val, "count": self.count}
+
+
+@dataclass
+class Pair:
+    """TopN entry (pilosa.go Pair)."""
+    id: int
+    count: int
+    key: str = ""
+
+    def to_dict(self) -> dict:
+        d = {"id": self.id, "count": self.count}
+        if self.key:
+            d["key"] = self.key
+        return d
+
+
+def merge_pairs(pair_lists: list[list[Pair]]) -> list[Pair]:
+    """Sum counts by id (executor.go:912 Pairs.Add reduce)."""
+    acc: dict[int, int] = {}
+    for pairs in pair_lists:
+        for p in pairs:
+            acc[p.id] = acc.get(p.id, 0) + p.count
+    return [Pair(i, c) for i, c in acc.items()]
+
+
+def sort_pairs(pairs: list[Pair], n: int | None = None) -> list[Pair]:
+    """Descending by count, ascending id tiebreak (pilosa.go Pairs.Sort)."""
+    out = sorted(pairs, key=lambda p: (-p.count, p.id))
+    return out[:n] if n else out
+
+
+@dataclass
+class FieldRow:
+    """One (field, row) of a GroupBy group (executor.go FieldRow)."""
+    field: str
+    row_id: int
+    row_key: str = ""
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {"field": self.field, "rowID": self.row_id}
+        if self.row_key:
+            d["rowKey"] = self.row_key
+        return d
+
+
+@dataclass
+class GroupCount:
+    group: list[FieldRow]
+    count: int
+
+    def to_dict(self) -> dict:
+        return {"group": [g.to_dict() for g in self.group],
+                "count": self.count}
+
+
+@dataclass
+class RowIdentifiers:
+    """Rows() result (executor.go RowIdentifiers)."""
+    rows: list[int] = field(default_factory=list)
+    keys: list[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"rows": self.rows} if not self.keys else {"keys": self.keys}

@@ -23,6 +23,7 @@ from ..core import (
     VIEW_STANDARD,
 )
 from ..ops import bitset, bsi
+from .attrs import AttrStore
 from . import time_quantum as tq
 from .view import View
 
@@ -112,6 +113,8 @@ class Field:
         self.options = options or FieldOptions()
         self.max_op_n = max_op_n
         self.views: dict[str, View] = {}
+        self.row_attrs = AttrStore(
+            None if path is None else os.path.join(path, ".row_attrs"))
         self._lock = threading.RLock()
         # shards known to have data on remote nodes (field.go:263)
         self.remote_available_shards: set[int] = set()

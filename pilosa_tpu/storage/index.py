@@ -10,6 +10,7 @@ import threading
 import numpy as np
 
 from ..core import EXISTENCE_FIELD_NAME, SHARD_WIDTH, VIEW_STANDARD
+from .attrs import AttrStore
 from .field import Field, FieldOptions, FIELD_TYPE_SET, CACHE_TYPE_NONE
 
 
@@ -31,6 +32,8 @@ class Index:
         self.track_existence = track_existence
         self.max_op_n = max_op_n
         self.fields: dict[str, Field] = {}
+        self.column_attrs = AttrStore(
+            None if path is None else os.path.join(path, ".column_attrs"))
         self._lock = threading.RLock()
 
         if create and track_existence:

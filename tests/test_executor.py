@@ -1,0 +1,307 @@
+"""Executor tests — mirrors executor_test.go coverage: bitmap algebra across
+shards, Count/Sum/Min/Max, BSI range conditions (incl. out-of-range and
+full-encompass fast paths), TopN two-phase, Rows pagination, GroupBy,
+Set/Clear/ClearRow/Store writes, Not, Shift, time-range Row, Options."""
+
+import numpy as np
+import pytest
+from datetime import datetime
+
+from pilosa_tpu.core import SHARD_WIDTH
+from pilosa_tpu.executor import Executor, RowResult, ValCount
+from pilosa_tpu.storage import FieldOptions, Holder
+
+
+@pytest.fixture
+def holder():
+    h = Holder(None)
+    return h
+
+
+@pytest.fixture
+def ex(holder):
+    return Executor(holder)
+
+
+def setup_set_field(holder, bits, index="i", field="f", **opts):
+    idx = holder.create_index_if_not_exists(index)
+    f = idx.create_field_if_not_exists(field,
+                                       FieldOptions(**opts) if opts else None)
+    rows = np.array([b[0] for b in bits])
+    cols = np.array([b[1] for b in bits])
+    f.import_bits(rows, cols)
+    idx.add_existence(cols)
+    return f
+
+
+def cols(res: RowResult):
+    return res.columns().tolist()
+
+
+# -- bitmap calls -----------------------------------------------------------
+
+def test_row(ex, holder):
+    setup_set_field(holder, [(10, 1), (10, SHARD_WIDTH + 2), (11, 3)])
+    res = ex.execute("i", "Row(f=10)")[0]
+    assert cols(res) == [1, SHARD_WIDTH + 2]
+    assert res.count() == 2
+
+
+def test_row_missing_field_errors(ex, holder):
+    holder.create_index("i")
+    with pytest.raises(Exception):
+        ex.execute("i", "Row(nope=1)")
+
+
+def test_intersect_union_difference_xor(ex, holder):
+    setup_set_field(holder, [
+        (1, 100), (1, 200), (1, SHARD_WIDTH + 7),
+        (2, 100), (2, SHARD_WIDTH + 7), (2, 300),
+    ])
+    assert cols(ex.execute("i", "Intersect(Row(f=1), Row(f=2))")[0]) == \
+        [100, SHARD_WIDTH + 7]
+    assert cols(ex.execute("i", "Union(Row(f=1), Row(f=2))")[0]) == \
+        [100, 200, 300, SHARD_WIDTH + 7]
+    assert cols(ex.execute("i", "Difference(Row(f=1), Row(f=2))")[0]) == [200]
+    assert cols(ex.execute("i", "Xor(Row(f=1), Row(f=2))")[0]) == [200, 300]
+
+
+def test_count(ex, holder):
+    setup_set_field(holder, [(1, c) for c in range(50)] +
+                    [(1, SHARD_WIDTH + c) for c in range(30)])
+    assert ex.execute("i", "Count(Row(f=1))")[0] == 80
+
+
+def test_not(ex, holder):
+    setup_set_field(holder, [(1, 10), (1, 20), (2, 30)])
+    res = ex.execute("i", "Not(Row(f=1))")[0]
+    assert cols(res) == [30]
+
+
+def test_shift(ex, holder):
+    setup_set_field(holder, [(1, 10), (1, 20)])
+    assert cols(ex.execute("i", "Shift(Row(f=1), n=5)")[0]) == [15, 25]
+
+
+def test_row_time_range(ex, holder):
+    idx = holder.create_index("i")
+    f = idx.create_field("f", FieldOptions(type="time", time_quantum="YMD"))
+    f.set_bit(1, 10, ts=datetime(2017, 1, 5))
+    f.set_bit(1, 20, ts=datetime(2017, 3, 5))
+    f.set_bit(1, 30, ts=datetime(2018, 1, 5))
+    res = ex.execute(
+        "i", "Row(f=1, from=2017-01-01T00:00, to=2017-12-31T00:00)")[0]
+    assert cols(res) == [10, 20]
+    # no time bounds -> standard view (all)
+    assert cols(ex.execute("i", "Row(f=1)")[0]) == [10, 20, 30]
+    # legacy Range call form
+    res = ex.execute(
+        "i", "Range(f=1, 2017-01-01T00:00, 2017-02-01T00:00)")[0]
+    assert cols(res) == [10]
+
+
+# -- BSI --------------------------------------------------------------------
+
+@pytest.fixture
+def bsi_holder(holder):
+    idx = holder.create_index("i")
+    f = idx.create_field("v", FieldOptions(type="int", min=-1000, max=1000))
+    cols_ = np.array([1, 2, 3, SHARD_WIDTH + 4, SHARD_WIDTH + 5])
+    vals = np.array([-500, 0, 250, 600, -1000])
+    f.import_values(cols_, vals)
+    idx.add_existence(cols_)
+    return holder
+
+
+def test_bsi_range_ops(ex, bsi_holder):
+    assert cols(ex.execute("i", "Row(v < 0)")[0]) == [1, SHARD_WIDTH + 5]
+    assert cols(ex.execute("i", "Row(v <= 0)")[0]) == [1, 2, SHARD_WIDTH + 5]
+    assert cols(ex.execute("i", "Row(v > 250)")[0]) == [SHARD_WIDTH + 4]
+    assert cols(ex.execute("i", "Row(v >= 250)")[0]) == [3, SHARD_WIDTH + 4]
+    assert cols(ex.execute("i", "Row(v == 250)")[0]) == [3]
+    assert cols(ex.execute("i", "Row(v != 250)")[0]) == \
+        [1, 2, SHARD_WIDTH + 4, SHARD_WIDTH + 5]
+    assert cols(ex.execute("i", "Row(v != null)")[0]) == \
+        [1, 2, 3, SHARD_WIDTH + 4, SHARD_WIDTH + 5]
+    assert cols(ex.execute("i", "Row(-600 < v < 300)")[0]) == [1, 2, 3]
+
+
+def test_bsi_out_of_range_semantics(ex, bsi_holder):
+    # LT above max -> everything not-null (executor.go:1650)
+    assert len(cols(ex.execute("i", "Row(v < 99999)")[0])) == 5
+    # GT above representable range -> empty
+    assert cols(ex.execute("i", "Row(v > 99999)")[0]) == []
+    # EQ out of range -> empty
+    assert cols(ex.execute("i", "Row(v == 99999)")[0]) == []
+    # NEQ out of range -> all not-null
+    assert len(cols(ex.execute("i", "Row(v != 99999)")[0])) == 5
+    # BETWEEN fully covering -> not-null
+    assert len(cols(ex.execute("i", "Row(-1000 <= v <= 1000)")[0])) == 5
+
+
+def test_sum_min_max(ex, bsi_holder):
+    got = ex.execute("i", "Sum(field=v)")[0]
+    assert got == ValCount(-650, 5)
+    assert ex.execute("i", "Min(field=v)")[0] == ValCount(-1000, 1)
+    assert ex.execute("i", "Max(field=v)")[0] == ValCount(600, 1)
+    # with filter child
+    got = ex.execute("i", "Sum(Row(v > 0), field=v)")[0]
+    assert got == ValCount(850, 2)
+    assert ex.execute("i", "Min(Row(v > -1000), field=v)")[0] == \
+        ValCount(-500, 1)
+
+
+# -- TopN -------------------------------------------------------------------
+
+def test_topn(ex, holder):
+    bits = []
+    for row, n in [(0, 5), (1, 3), (2, 10), (3, 1)]:
+        bits += [(row, 1000 + row * SHARD_WIDTH // 2 + i) for i in range(n)]
+    setup_set_field(holder, bits)
+    pairs = ex.execute("i", "TopN(f, n=2)")[0]
+    assert [(p.id, p.count) for p in pairs] == [(2, 10), (0, 5)]
+    # all rows
+    pairs = ex.execute("i", "TopN(f)")[0]
+    assert [(p.id, p.count) for p in pairs] == \
+        [(2, 10), (0, 5), (1, 3), (3, 1)]
+
+
+def test_topn_with_filter_and_ids(ex, holder):
+    setup_set_field(holder, [
+        (0, 10), (0, 20), (0, 30),
+        (1, 10), (1, 20),
+        (2, 99),
+    ])
+    pairs = ex.execute("i", "TopN(f, Row(f=0), n=5)")[0]
+    assert [(p.id, p.count) for p in pairs] == [(0, 3), (1, 2)]
+    pairs = ex.execute("i", "TopN(f, ids=[1,2])")[0]
+    assert [(p.id, p.count) for p in pairs] == [(1, 2), (2, 1)]
+
+
+# -- Rows -------------------------------------------------------------------
+
+def test_rows(ex, holder):
+    setup_set_field(holder, [(5, 1), (7, 2), (9, SHARD_WIDTH + 3)])
+    assert ex.execute("i", "Rows(f)")[0].rows == [5, 7, 9]
+    assert ex.execute("i", "Rows(f, previous=5)")[0].rows == [7, 9]
+    assert ex.execute("i", "Rows(f, limit=2)")[0].rows == [5, 7]
+    assert ex.execute("i", "Rows(f, column=2)")[0].rows == [7]
+
+
+# -- GroupBy ----------------------------------------------------------------
+
+def test_group_by(ex, holder):
+    idx = holder.create_index("i")
+    fa = idx.create_field("a")
+    fb = idx.create_field("b")
+    # a=0: cols {1,2,3}; a=1: cols {2,3}
+    fa.import_bits(np.array([0, 0, 0, 1, 1]), np.array([1, 2, 3, 2, 3]))
+    # b=0: cols {2}; b=1: cols {3, S+1}
+    fb.import_bits(np.array([0, 1, 1]), np.array([2, 3, SHARD_WIDTH + 1]))
+    got = ex.execute("i", "GroupBy(Rows(a), Rows(b))")[0]
+    as_tuples = [tuple((fr.field, fr.row_id) for fr in g.group) + (g.count,)
+                 for g in got]
+    assert as_tuples == [
+        (("a", 0), ("b", 0), 1),   # {2}
+        (("a", 0), ("b", 1), 1),   # {3}
+        (("a", 1), ("b", 0), 1),   # {2}
+        (("a", 1), ("b", 1), 1),   # {3}
+    ]
+
+
+def test_group_by_with_filter_and_limit(ex, holder):
+    idx = holder.create_index("i")
+    fa = idx.create_field("a")
+    fa.import_bits(np.array([0, 0, 1]), np.array([1, 2, 2]))
+    got = ex.execute("i", "GroupBy(Rows(a), limit=1)")[0]
+    assert len(got) == 1
+    assert got[0].count == 2
+    got = ex.execute("i", "GroupBy(Rows(a), Row(a=1))")[0]
+    # filter = col {2}
+    as_tuples = [(g.group[0].row_id, g.count) for g in got]
+    assert as_tuples == [(0, 1), (1, 1)]
+
+
+# -- writes -----------------------------------------------------------------
+
+def test_set_clear(ex, holder):
+    holder.create_index("i").create_field("f")
+    assert ex.execute("i", "Set(100, f=1)") == [True]
+    assert ex.execute("i", "Set(100, f=1)") == [False]
+    assert cols(ex.execute("i", "Row(f=1)")[0]) == [100]
+    # existence tracked
+    assert cols(ex.execute("i", "Not(Row(f=9))")[0]) == [100]
+    assert ex.execute("i", "Clear(100, f=1)") == [True]
+    assert ex.execute("i", "Clear(100, f=1)") == [False]
+    assert cols(ex.execute("i", "Row(f=1)")[0]) == []
+
+
+def test_set_int_field(ex, holder):
+    holder.create_index("i").create_field(
+        "v", FieldOptions(type="int", min=0, max=100))
+    ex.execute("i", "Set(5, v=42)")
+    assert ex.execute("i", "Sum(field=v)")[0] == ValCount(42, 1)
+
+
+def test_set_with_timestamp(ex, holder):
+    holder.create_index("i").create_field(
+        "t", FieldOptions(type="time", time_quantum="YMD"))
+    ex.execute("i", "Set(7, t=3, 2017-05-05T00:00)")
+    res = ex.execute(
+        "i", "Row(t=3, from=2017-05-01T00:00, to=2017-06-01T00:00)")[0]
+    assert cols(res) == [7]
+
+
+def test_clear_row_and_store(ex, holder):
+    setup_set_field(holder, [(1, 10), (1, 20), (2, 20)])
+    assert ex.execute("i", "ClearRow(f=1)") == [True]
+    assert cols(ex.execute("i", "Row(f=1)")[0]) == []
+    assert cols(ex.execute("i", "Row(f=2)")[0]) == [20]
+    # Store: copy row 2 into row 9
+    assert ex.execute("i", "Store(Row(f=2), f=9)") == [True]
+    assert cols(ex.execute("i", "Row(f=9)")[0]) == [20]
+
+
+def test_set_attrs(ex, holder):
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    ex.execute("i", "SetRowAttrs(f, 3, color=blue, weight=2)")
+    assert idx.field("f").row_attrs.attrs(3) == \
+        {"color": "blue", "weight": 2}
+    ex.execute("i", "SetColumnAttrs(9, active=true)")
+    assert idx.column_attrs.attrs(9) == {"active": True}
+
+
+def test_options_shards(ex, holder):
+    setup_set_field(holder, [(1, 5), (1, SHARD_WIDTH + 5),
+                             (1, 3 * SHARD_WIDTH + 5)])
+    res = ex.execute("i", "Options(Row(f=1), shards=[0, 3])")[0]
+    assert cols(res) == [5, 3 * SHARD_WIDTH + 5]
+
+
+# -- plan cache -------------------------------------------------------------
+
+def test_plan_cache_reuse(ex, holder):
+    setup_set_field(holder, [(1, 5), (2, 5), (1, 6)])
+    ex.execute("i", "Count(Intersect(Row(f=1), Row(f=2)))")
+    n1 = len(ex.compiler._cache)
+    ex.execute("i", "Count(Intersect(Row(f=1), Row(f=2)))")
+    assert len(ex.compiler._cache) == n1  # cache hit, no recompile
+
+
+def test_multiple_calls_in_one_query(ex, holder):
+    setup_set_field(holder, [(1, 5)])
+    out = ex.execute("i", "Set(6, f=1)Count(Row(f=1))")
+    assert out == [True, 2]
+
+
+def test_shift_zero_is_identity(ex, holder):
+    setup_set_field(holder, [(1, 10)])
+    assert cols(ex.execute("i", "Shift(Row(f=1), n=0)")[0]) == [10]
+    assert cols(ex.execute("i", "Shift(Row(f=1))")[0]) == [10]
+
+
+def test_set_attrs_bool_id_rejected(ex, holder):
+    holder.create_index("i").create_field("f")
+    with pytest.raises(Exception):
+        ex.execute("i", "SetColumnAttrs(true, active=true)")
