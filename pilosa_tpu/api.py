@@ -1,0 +1,266 @@
+"""API façade: every externally-reachable operation, validated against
+cluster state (reference api.go:135-1330).
+
+The HTTP layer wraps this and only this (http/handler.go:276 wraps *API);
+nothing in the server package touches holder/executor directly.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from . import __version__
+from .core import SHARD_WIDTH
+from .executor import Executor
+from .pql import parse
+from .storage import FieldOptions, Holder
+
+# Cluster states (cluster.go:47-50).
+STATE_STARTING = "STARTING"
+STATE_NORMAL = "NORMAL"
+STATE_DEGRADED = "DEGRADED"
+STATE_RESIZING = "RESIZING"
+
+# Which API methods are allowed in which states (api.go:99 validAPIMethods).
+_DEGRADED_OK = {
+    "Query", "Schema", "Status", "Version", "Info", "GetIndex", "GetIndexes",
+    "ExportCSV", "ShardNodes", "Hosts",
+}
+_RESIZING_OK = {"Status", "Version", "Info", "Hosts", "ClusterMessage"}
+
+
+class ApiError(Exception):
+    pass
+
+
+class NotFoundError(ApiError):
+    pass
+
+
+class ConflictError(ApiError):
+    pass
+
+
+class DisallowedError(ApiError):
+    """Method not allowed in current cluster state (api.go:119 validate)."""
+
+
+class API:
+    def __init__(self, holder: Holder, cluster=None, stats=None):
+        self.holder = holder
+        self.executor = Executor(holder)
+        self.cluster = cluster  # None = single-node
+        self.stats = stats
+        self._lock = threading.RLock()
+
+    # -- state validation (api.go:119) -------------------------------------
+
+    def state(self) -> str:
+        if self.cluster is None:
+            return STATE_NORMAL
+        return self.cluster.state
+
+    def _validate(self, method: str):
+        st = self.state()
+        if st == STATE_NORMAL:
+            return
+        if st == STATE_DEGRADED and method in _DEGRADED_OK:
+            return
+        if st == STATE_RESIZING and method in _RESIZING_OK:
+            return
+        raise DisallowedError(
+            f"api method {method} not allowed in state {st}")
+
+    # -- query (api.go:135 Query) ------------------------------------------
+
+    def query(self, index: str, query: str, shards=None) -> list[Any]:
+        self._validate("Query")
+        if self.stats:
+            self.stats.count("query", 1)
+        if self.cluster is not None:
+            return self.cluster.execute(index, query, shards)
+        return self.executor.execute(index, query, shards)
+
+    # -- DDL ---------------------------------------------------------------
+
+    def create_index(self, name: str, keys: bool = False,
+                     track_existence: bool = True):
+        self._validate("CreateIndex")
+        try:
+            idx = self.holder.create_index(name, keys=keys,
+                                           track_existence=track_existence)
+        except FileExistsError as e:
+            raise ConflictError(str(e))
+        except ValueError as e:
+            raise ApiError(str(e))
+        return idx
+
+    def delete_index(self, name: str):
+        self._validate("DeleteIndex")
+        try:
+            self.holder.delete_index(name)
+        except ValueError as e:
+            raise NotFoundError(str(e))
+
+    def create_field(self, index: str, field: str,
+                     options: dict | None = None):
+        self._validate("CreateField")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        opts = FieldOptions.from_dict(options or {})
+        try:
+            return idx.create_field(field, opts)
+        except FileExistsError as e:
+            raise ConflictError(str(e))
+        except ValueError as e:
+            raise ApiError(str(e))
+
+    def delete_field(self, index: str, field: str):
+        self._validate("DeleteField")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        try:
+            idx.delete_field(field)
+        except ValueError as e:
+            raise NotFoundError(str(e))
+
+    def schema(self) -> list[dict]:
+        self._validate("Schema")
+        return self.holder.schema()
+
+    def apply_schema(self, schema: list[dict]):
+        """POST /schema (http/handler.go handlePostSchema)."""
+        self._validate("ApplySchema")
+        for idx_def in schema:
+            name = idx_def["name"]
+            opts = idx_def.get("options", {})
+            idx = self.holder.create_index_if_not_exists(
+                name, keys=opts.get("keys", False),
+                track_existence=opts.get("trackExistence", True))
+            for fdef in idx_def.get("fields", []):
+                idx.create_field_if_not_exists(
+                    fdef["name"], FieldOptions.from_dict(
+                        fdef.get("options", {})))
+
+    # -- import (api.go:920 Import / :1031 ImportValue / :368 ImportRoaring)
+
+    def import_bits(self, index: str, field: str,
+                    row_ids=None, column_ids=None, timestamps=None,
+                    clear: bool = False):
+        self._validate("Import")
+        idx, f = self._index_field(index, field)
+        rows = np.asarray(row_ids or [], dtype=np.int64)
+        cols = np.asarray(column_ids or [], dtype=np.int64)
+        if rows.size != cols.size:
+            raise ApiError("rowIDs and columnIDs length mismatch")
+        ts = None
+        if timestamps and len(timestamps) != cols.size:
+            raise ApiError("timestamps length mismatch")
+        if timestamps:
+            from datetime import datetime
+            ts = [None if t in (None, 0) else datetime.utcfromtimestamp(t)
+                  for t in timestamps]
+        f.import_bits(rows, cols, ts, clear=clear)
+        if not clear:
+            idx.add_existence(cols)
+
+    def import_values(self, index: str, field: str,
+                      column_ids=None, values=None):
+        self._validate("ImportValue")
+        idx, f = self._index_field(index, field)
+        cols = np.asarray(column_ids or [], dtype=np.int64)
+        vals = np.asarray(values or [], dtype=np.int64)
+        if cols.size != vals.size:
+            raise ApiError("columnIDs and values length mismatch")
+        f.import_values(cols, vals)
+        idx.add_existence(cols)
+
+    def import_roaring(self, index: str, field: str, shard: int,
+                       views: dict[str, bytes], clear: bool = False):
+        """Import pre-serialized pilosa-roaring bitmaps, one per view
+        (api.go:368 ImportRoaring)."""
+        self._validate("ImportRoaring")
+        idx, f = self._index_field(index, field)
+        from .storage.roaring_io import unpack_roaring
+        all_cols = []
+        for view_name, data in views.items():
+            if not view_name:
+                view_name = "standard"
+            rows, cols_local = unpack_roaring(data)
+            v = f._create_view_if_not_exists(view_name)
+            frag = v.create_fragment_if_not_exists(shard)
+            if clear:
+                frag.bulk_import(rows, cols_local, clear=True)
+            else:
+                frag.bulk_import(rows, cols_local)
+                if view_name == "standard":
+                    all_cols.append(cols_local + shard * SHARD_WIDTH)
+        if all_cols:
+            idx.add_existence(np.unique(np.concatenate(all_cols)))
+
+    def _index_field(self, index: str, field: str):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        f = idx.field(field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        return idx, f
+
+    # -- export (api.go ExportCSV) -----------------------------------------
+
+    def export_csv(self, index: str, field: str, shard: int) -> str:
+        self._validate("ExportCSV")
+        _, f = self._index_field(index, field)
+        from .core import VIEW_STANDARD
+        v = f.view(VIEW_STANDARD)
+        frag = None if v is None else v.fragment(shard)
+        if frag is None:
+            return ""
+        from .ops import bitset
+        rows, cols = bitset.unpack_fragment(frag.words)
+        offset = shard * SHARD_WIDTH
+        return "".join(f"{r},{c + offset}\n" for r, c in zip(rows, cols))
+
+    # -- info/status -------------------------------------------------------
+
+    def status(self) -> dict:
+        self._validate("Status")
+        nodes = [{"id": "node0", "uri": "", "isCoordinator": True,
+                  "state": "READY"}]
+        state = STATE_NORMAL
+        if self.cluster is not None:
+            nodes = self.cluster.node_statuses()
+            state = self.cluster.state
+        return {"state": state, "nodes": nodes,
+                "localID": nodes[0]["id"] if self.cluster is None
+                else self.cluster.node_id}
+
+    def info(self) -> dict:
+        self._validate("Info")
+        return {"shardWidth": SHARD_WIDTH}
+
+    def version(self) -> str:
+        return __version__
+
+    def max_shards(self) -> dict[str, int]:
+        """(api.go MaxShards, /internal/shards/max)"""
+        return {name: max(idx.available_shards(), default=0)
+                for name, idx in self.holder.indexes.items()}
+
+    def shard_nodes(self, index: str, shard: int) -> list[dict]:
+        self._validate("ShardNodes")
+        if self.cluster is None:
+            return [{"id": "node0", "uri": ""}]
+        return self.cluster.shard_nodes_info(index, shard)
+
+    def recalculate_caches(self):
+        self._validate("RecalculateCaches")
+        # Per-fragment TopN caches are recomputed exactly on device per
+        # query; nothing stale to recalculate.
+        return None

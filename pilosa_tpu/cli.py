@@ -1,0 +1,253 @@
+"""CLI: pilosa-tpu server|import|export|check|inspect|generate-config
+(reference cmd/root.go + ctl/).
+
+Run as `python -m pilosa_tpu <command>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import urllib.request
+
+
+def _http(method: str, url: str, body: bytes | None = None,
+          ctype: str = "application/json",
+          ok_codes: tuple[int, ...] = ()) -> dict:
+    req = urllib.request.Request(url, data=body, method=method)
+    if body is not None:
+        req.add_header("Content-Type", ctype)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            data = resp.read()
+    except urllib.error.HTTPError as e:
+        if e.code in ok_codes:
+            return {}
+        raise SystemExit(f"error: {e.code} {e.read().decode().strip()}")
+    return json.loads(data) if data.strip() else {}
+
+
+def cmd_server(args) -> int:
+    """(ctl/server.go + server/server.go Command.Start)"""
+    from .server.server import Config, Server
+
+    overrides = dict(data_dir=args.data_dir, bind=args.bind,
+                     replica_n=args.replicas, node_id=args.node_id)
+    if args.cluster_hosts:
+        overrides["cluster_hosts"] = args.cluster_hosts.split(",")
+    if args.config:
+        cfg = Config.from_toml(args.config, **overrides)
+    else:
+        cfg = Config.from_env(**overrides)
+    srv = Server(cfg)
+    srv.open()
+    import threading
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    srv.logger.info("shutting down")
+    srv.close()
+    return 0
+
+
+def cmd_import(args) -> int:
+    """CSV import: row,col[,timestamp] or col,value for -field-type=int
+    (ctl/import.go:44-399)."""
+    base = f"http://{args.host}"
+    if args.create:
+        # 409 (already exists) is success for --create ("if missing")
+        _http("POST", f"{base}/index/{args.index}",
+              json.dumps({}).encode(), ok_codes=(409,))
+        opts = {}
+        if args.field_type == "int":
+            opts = {"type": "int", "min": args.min, "max": args.max}
+        elif args.field_type == "time":
+            opts = {"type": "time", "timeQuantum": args.time_quantum}
+        _http("POST", f"{base}/index/{args.index}/field/{args.field}",
+              json.dumps({"options": opts}).encode(), ok_codes=(409,))
+
+    url = f"{base}/index/{args.index}/field/{args.field}/import"
+    total = 0
+    rows, cols, vals, tss = [], [], [], []
+
+    def flush():
+        nonlocal rows, cols, vals, tss, total
+        if not cols:
+            return
+        if args.field_type == "int":
+            payload = {"columnIDs": cols, "values": vals}
+        else:
+            payload = {"rowIDs": rows, "columnIDs": cols}
+            if any(tss):
+                payload["timestamps"] = tss
+            if args.clear:
+                payload["clear"] = True
+        _http("POST", url, json.dumps(payload).encode())
+        total += len(cols)
+        rows, cols, vals, tss = [], [], [], []
+
+    files = args.files or ["-"]
+    for path in files:
+        fh = sys.stdin if path == "-" else open(path)
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            parts = line.split(",")
+            if args.field_type == "int":
+                cols.append(int(parts[0]))
+                vals.append(int(parts[1]))
+            else:
+                rows.append(int(parts[0]))
+                cols.append(int(parts[1]))
+                tss.append(int(parts[2]) if len(parts) > 2 else 0)
+            if len(cols) >= args.batch_size:
+                flush()
+        if fh is not sys.stdin:
+            fh.close()
+    flush()
+    print(f"imported {total} records into {args.index}/{args.field}")
+    return 0
+
+
+def cmd_export(args) -> int:
+    """(ctl/export.go:35-112)"""
+    base = f"http://{args.host}"
+    maxes = _http("GET", f"{base}/internal/shards/max")["standard"]
+    max_shard = maxes.get(args.index, 0)
+    out = sys.stdout if args.output == "-" else open(args.output, "w")
+    for shard in range(max_shard + 1):
+        url = (f"{base}/export?index={args.index}&field={args.field}"
+               f"&shard={shard}")
+        req = urllib.request.Request(url)
+        with urllib.request.urlopen(req) as resp:
+            out.write(resp.read().decode())
+    if out is not sys.stdout:
+        out.close()
+    return 0
+
+
+def cmd_check(args) -> int:
+    """Offline fragment file integrity check (ctl/check.go:28-135)."""
+    from .storage.fragment import Fragment
+
+    ok = True
+    for path in args.files:
+        if path.endswith(".wal"):
+            continue
+        try:
+            frag = Fragment(path, "check", "check", "check", 0)
+            n = int(frag.words.any(axis=1).sum())
+            print(f"{path}: OK rows_with_data={n}")
+            frag.close()
+        except Exception as e:
+            ok = False
+            print(f"{path}: CORRUPT {e}")
+    return 0 if ok else 1
+
+
+def cmd_inspect(args) -> int:
+    """Fragment stats (ctl/inspect.go:30-110)."""
+    import numpy as np
+
+    from .storage.fragment import Fragment
+
+    for path in args.files:
+        frag = Fragment(path, "inspect", "inspect", "inspect", 0)
+        words = frag.words
+        n_bits = int(np.bitwise_count(words).sum())
+        rows_used = int(words.any(axis=1).sum())
+        density = n_bits / words.size / 32 if words.size else 0.0
+        print(json.dumps({
+            "path": path, "rows": words.shape[0], "rowsWithData": rows_used,
+            "bits": n_bits, "density": round(density, 6),
+            "sizeBytes": words.nbytes,
+        }))
+        frag.close()
+    return 0
+
+
+DEFAULT_CONFIG = """\
+# pilosa-tpu configuration
+data-dir = "~/.pilosa_tpu"
+bind = "localhost:10101"
+max-op-n = 10000
+
+[cluster]
+# hosts = ["localhost:10101", "localhost:10102"]
+replicas = 1
+
+[anti-entropy]
+interval = 600
+"""
+
+
+def cmd_generate_config(args) -> int:
+    print(DEFAULT_CONFIG, end="")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="pilosa-tpu",
+        description="TPU-native distributed bitmap index")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("server", help="run a server node")
+    sp.add_argument("-c", "--config", help="TOML config file")
+    sp.add_argument("-d", "--data-dir", default=None)
+    sp.add_argument("-b", "--bind", default=None)
+    sp.add_argument("--cluster-hosts", default=None,
+                    help="comma-separated host:port list (multi-node)")
+    sp.add_argument("--node-id", default=None)
+    sp.add_argument("--replicas", type=int, default=None)
+    sp.set_defaults(fn=cmd_server)
+
+    sp = sub.add_parser("import", help="bulk-import CSV")
+    sp.add_argument("-host", default="localhost:10101")
+    sp.add_argument("-i", "--index", required=True)
+    sp.add_argument("-f", "--field", required=True)
+    sp.add_argument("--create", action="store_true",
+                    help="create index/field if missing")
+    sp.add_argument("--field-type", default="set",
+                    choices=["set", "int", "time"])
+    sp.add_argument("--min", type=int, default=0)
+    sp.add_argument("--max", type=int, default=2 ** 32)
+    sp.add_argument("--time-quantum", default="YMD")
+    sp.add_argument("--clear", action="store_true")
+    sp.add_argument("--batch-size", type=int, default=100_000,
+                    help="records per import request (ctl/import.go "
+                         "importBufferSize)")
+    sp.add_argument("files", nargs="*")
+    sp.set_defaults(fn=cmd_import)
+
+    sp = sub.add_parser("export", help="export a field as CSV")
+    sp.add_argument("-host", default="localhost:10101")
+    sp.add_argument("-i", "--index", required=True)
+    sp.add_argument("-f", "--field", required=True)
+    sp.add_argument("-o", "--output", default="-")
+    sp.set_defaults(fn=cmd_export)
+
+    sp = sub.add_parser("check", help="check fragment file integrity")
+    sp.add_argument("files", nargs="+")
+    sp.set_defaults(fn=cmd_check)
+
+    sp = sub.add_parser("inspect", help="inspect fragment file stats")
+    sp.add_argument("files", nargs="+")
+    sp.set_defaults(fn=cmd_inspect)
+
+    sp = sub.add_parser("generate-config", help="print default config")
+    sp.set_defaults(fn=cmd_generate_config)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+"""HTTP server layer (reference http/ + server/)."""
+
+from .server import Config, Server  # noqa: F401

@@ -1,0 +1,285 @@
+"""HTTP handler: the public + internal REST surface (reference
+http/handler.go:276-314 route table).
+
+Wraps only the API façade, like the reference (handler.go:60 Handler wraps
+*pilosa.API).  stdlib ThreadingHTTPServer + a regex route table replaces
+gorilla/mux; JSON replaces protobuf on the public surface (the reference
+already speaks JSON for DDL and query responses; bulk imports also accept
+the pilosa-roaring binary format for compatibility).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from .. import __version__
+from ..api import API, ApiError, ConflictError, DisallowedError, NotFoundError
+from ..executor import RowResult, ValCount, RowIdentifiers
+from ..executor.results import GroupCount, Pair
+
+
+def serialize_result(r) -> object:
+    """Query result -> JSON-able (reference http/response.go)."""
+    if isinstance(r, RowResult):
+        return r.to_dict()
+    if isinstance(r, ValCount):
+        return r.to_dict()
+    if isinstance(r, RowIdentifiers):
+        return r.to_dict()
+    if isinstance(r, list):
+        if r and isinstance(r[0], Pair):
+            return [p.to_dict() for p in r]
+        if r and isinstance(r[0], GroupCount):
+            return [g.to_dict() for g in r]
+        return [serialize_result(x) for x in r]
+    return r
+
+
+class Router:
+    """Method+regex route table."""
+
+    def __init__(self):
+        self.routes: list[tuple[str, re.Pattern, callable]] = []
+
+    def add(self, method: str, pattern: str, fn):
+        rx = re.compile("^" + re.sub(
+            r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$")
+        self.routes.append((method, rx, fn))
+
+    def match(self, method: str, path: str):
+        found_path = False
+        for m, rx, fn in self.routes:
+            mt = rx.match(path)
+            if mt:
+                found_path = True
+                if m == method:
+                    return fn, mt.groupdict()
+        return ("method_not_allowed" if found_path else None), {}
+
+
+def build_router(api: API, server=None) -> Router:
+    r = Router()
+
+    # -- public (handler.go:276-300) --------------------------------------
+    def home(req, args):
+        return {"message": "pilosa-tpu " + __version__}
+
+    r.add("GET", "/", home)
+    r.add("GET", "/version", lambda req, a: {"version": api.version()})
+    r.add("GET", "/info", lambda req, a: api.info())
+    r.add("GET", "/status", lambda req, a: api.status())
+    r.add("GET", "/schema", lambda req, a: {"indexes": api.schema()})
+
+    def post_schema(req, args):
+        api.apply_schema(req.json().get("indexes", []))
+        return {}
+
+    r.add("POST", "/schema", post_schema)
+
+    def get_indexes(req, args):
+        return {"indexes": api.schema()}
+
+    r.add("GET", "/index", get_indexes)
+
+    def get_index(req, args):
+        for idx in api.schema():
+            if idx["name"] == args["index"]:
+                return idx
+        raise NotFoundError(f"index not found: {args['index']}")
+
+    r.add("GET", "/index/{index}", get_index)
+
+    def post_index(req, args):
+        body = req.json()
+        opts = body.get("options", {})
+        api.create_index(args["index"], keys=opts.get("keys", False),
+                         track_existence=opts.get("trackExistence", True))
+        return {}
+
+    r.add("POST", "/index/{index}", post_index)
+
+    def delete_index(req, args):
+        api.delete_index(args["index"])
+        return {}
+
+    r.add("DELETE", "/index/{index}", delete_index)
+
+    def post_field(req, args):
+        body = req.json()
+        api.create_field(args["index"], args["field"],
+                         body.get("options", {}))
+        return {}
+
+    r.add("POST", "/index/{index}/field/{field}", post_field)
+
+    def delete_field(req, args):
+        api.delete_field(args["index"], args["field"])
+        return {}
+
+    r.add("DELETE", "/index/{index}/field/{field}", delete_field)
+
+    def post_query(req, args):
+        query = req.body.decode()
+        shards = None
+        if "shards" in req.query:
+            shards = [int(s) for s in req.query["shards"][0].split(",")]
+        results = api.query(args["index"], query, shards)
+        return {"results": [serialize_result(x) for x in results]}
+
+    r.add("POST", "/index/{index}/query", post_query)
+
+    def post_import(req, args):
+        body = req.json()
+        if "values" in body:
+            api.import_values(args["index"], args["field"],
+                              body.get("columnIDs"), body.get("values"))
+        else:
+            api.import_bits(args["index"], args["field"],
+                            body.get("rowIDs"), body.get("columnIDs"),
+                            body.get("timestamps"),
+                            clear=body.get("clear", False))
+        return {}
+
+    r.add("POST", "/index/{index}/field/{field}/import", post_import)
+
+    def post_import_roaring(req, args):
+        clear = req.query.get("clear", ["false"])[0] == "true"
+        ctype = req.headers.get("Content-Type", "")
+        if ctype.startswith("application/json"):
+            import base64
+            body = req.json()
+            views = {k: base64.b64decode(v)
+                     for k, v in body.get("views", {}).items()}
+        else:
+            views = {"standard": req.body}
+        api.import_roaring(args["index"], args["field"],
+                           int(args["shard"]), views, clear=clear)
+        return {}
+
+    r.add("POST", "/index/{index}/field/{field}/import-roaring/{shard}",
+          post_import_roaring)
+
+    def get_export(req, args):
+        index = req.query.get("index", [""])[0]
+        field = req.query.get("field", [""])[0]
+        shard = int(req.query.get("shard", ["0"])[0])
+        return ("text/csv", api.export_csv(index, field, shard))
+
+    r.add("GET", "/export", get_export)
+
+    r.add("POST", "/recalculate-caches",
+          lambda req, a: api.recalculate_caches() or {})
+
+    # -- observability (handler.go:280-282) -------------------------------
+    if api.stats is not None:
+        r.add("GET", "/metrics",
+              lambda req, a: ("text/plain; version=0.0.4",
+                              api.stats.prometheus_text()))
+        r.add("GET", "/debug/vars", lambda req, a: api.stats.snapshot())
+
+    def debug_traces(req, args):
+        from ..utils.tracing import GLOBAL_TRACER
+        tid = req.query.get("trace", [None])[0]
+        return {"spans": GLOBAL_TRACER.spans(tid)}
+
+    r.add("GET", "/debug/traces", debug_traces)
+
+    # -- internal (handler.go:302-314) ------------------------------------
+    r.add("GET", "/internal/shards/max",
+          lambda req, a: {"standard": api.max_shards()})
+
+    def fragment_nodes(req, args):
+        index = req.query.get("index", [""])[0]
+        shard = int(req.query.get("shard", ["0"])[0])
+        return api.shard_nodes(index, shard)
+
+    r.add("GET", "/internal/fragment/nodes", fragment_nodes)
+
+    if server is not None:
+        server.register_internal_routes(r)
+
+    return r
+
+
+class _HandlerClass(BaseHTTPRequestHandler):
+    router: Router = None
+    protocol_version = "HTTP/1.1"
+
+    # request helpers
+    def json(self):
+        if not self.body:
+            return {}
+        try:
+            return json.loads(self.body)
+        except json.JSONDecodeError as e:
+            raise ApiError(f"invalid JSON body: {e}")
+
+    @property
+    def query(self):
+        return self._query
+
+    def _handle(self, method: str):
+        parsed = urlparse(self.path)
+        self._query = parse_qs(parsed.query)
+        length = int(self.headers.get("Content-Length") or 0)
+        self.body = self.rfile.read(length) if length else b""
+        fn, args = self.router.match(method, parsed.path)
+        try:
+            if fn is None:
+                self._send(404, {"error": f"path not found: {parsed.path}"})
+                return
+            if fn == "method_not_allowed":
+                self._send(405, {"error": "method not allowed"})
+                return
+            out = fn(self, args)
+            if isinstance(out, tuple):
+                ctype, payload = out
+                self._send_raw(200, ctype, payload.encode()
+                               if isinstance(payload, str) else payload)
+            else:
+                self._send(200, out)
+        except NotFoundError as e:
+            self._send(404, {"error": str(e)})
+        except ConflictError as e:
+            self._send(409, {"error": str(e)})
+        except DisallowedError as e:
+            self._send(400, {"error": str(e)})
+        except (ApiError, ValueError) as e:
+            self._send(400, {"error": str(e)})
+        except Exception as e:  # panic guard (handler.go:325 recover)
+            traceback.print_exc()
+            self._send(500, {"error": f"internal error: {e}"})
+
+    def _send(self, code: int, obj):
+        self._send_raw(code, "application/json",
+                       (json.dumps(obj) + "\n").encode())
+
+    def _send_raw(self, code: int, ctype: str, payload: bytes):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        self._handle("GET")
+
+    def do_POST(self):
+        self._handle("POST")
+
+    def do_DELETE(self):
+        self._handle("DELETE")
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+
+def make_http_server(api: API, host: str = "localhost", port: int = 10101,
+                     server=None) -> ThreadingHTTPServer:
+    router = build_router(api, server)
+    cls = type("Handler", (_HandlerClass,), {"router": router})
+    return ThreadingHTTPServer((host, port), cls)

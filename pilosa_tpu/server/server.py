@@ -1,0 +1,158 @@
+"""Server: composition root (reference server.go:46 Server,
+server/server.go:137 Command.Start).
+
+Builds holder -> API -> HTTP handler and runs background monitors.  Config
+cascades TOML file < PILOSA_TPU_* env < explicit kwargs (reference
+cmd/root.go:60 setAllConfig).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+
+from ..api import API
+from ..storage import Holder
+from ..utils.logger import Logger
+from ..utils.stats import StatsClient
+from .handler import make_http_server
+
+
+@dataclasses.dataclass
+class Config:
+    """(reference server/config.go:36 Config)"""
+    data_dir: str = "~/.pilosa_tpu"
+    bind: str = "localhost:10101"
+    max_op_n: int = 10000
+    # cluster
+    node_id: str = "node0"
+    cluster_hosts: list = dataclasses.field(default_factory=list)
+    replica_n: int = 1
+    # monitors
+    anti_entropy_interval: float = 600.0
+    metric_poll_interval: float = 60.0
+    verbose: bool = False
+
+    @classmethod
+    def from_env(cls, **overrides) -> "Config":
+        cfg = cls()
+        cls._apply_env(cfg)
+        cls._apply_overrides(cfg, overrides)
+        return cfg
+
+    @staticmethod
+    def _apply_env(cfg):
+        env_map = {
+            "PILOSA_TPU_DATA_DIR": ("data_dir", str),
+            "PILOSA_TPU_BIND": ("bind", str),
+            "PILOSA_TPU_NODE_ID": ("node_id", str),
+            "PILOSA_TPU_REPLICA_N": ("replica_n", int),
+            "PILOSA_TPU_CLUSTER_HOSTS": (
+                "cluster_hosts", lambda s: s.split(",") if s else []),
+            "PILOSA_TPU_ANTI_ENTROPY_INTERVAL": (
+                "anti_entropy_interval", float),
+            "PILOSA_TPU_VERBOSE": ("verbose", lambda s: s == "true"),
+        }
+        for env, (attr, conv) in env_map.items():
+            if env in os.environ:
+                setattr(cfg, attr, conv(os.environ[env]))
+
+    @staticmethod
+    def _apply_overrides(cfg, overrides):
+        for k, v in overrides.items():
+            if v is not None:
+                setattr(cfg, k, v)
+
+    @classmethod
+    def from_toml(cls, path: str, **overrides) -> "Config":
+        """Precedence: TOML file < PILOSA_TPU_* env < explicit kwargs
+        (reference cmd/root.go:60 setAllConfig)."""
+        import tomllib
+        with open(path, "rb") as f:
+            doc = tomllib.load(f)
+        cfg = cls()
+        mapping = {
+            "data-dir": "data_dir", "bind": "bind", "max-op-n": "max_op_n",
+        }
+        for key, attr in mapping.items():
+            if key in doc:
+                setattr(cfg, attr, doc[key])
+        cluster = doc.get("cluster", {})
+        if "hosts" in cluster:
+            cfg.cluster_hosts = cluster["hosts"]
+        if "replicas" in cluster:
+            cfg.replica_n = cluster["replicas"]
+        if "anti-entropy" in doc and "interval" in doc["anti-entropy"]:
+            cfg.anti_entropy_interval = float(doc["anti-entropy"]["interval"])
+        cls._apply_env(cfg)
+        cls._apply_overrides(cfg, overrides)
+        return cfg
+
+
+class Server:
+    def __init__(self, config: Config | None = None):
+        self.config = config or Config()
+        self.logger = Logger(verbose=self.config.verbose)
+        self.stats = StatsClient()
+        data_dir = os.path.expanduser(self.config.data_dir)
+        self.holder = Holder(data_dir, max_op_n=self.config.max_op_n)
+        self.cluster = None
+        if self.config.cluster_hosts:
+            from ..parallel.cluster import Cluster
+            self.cluster = Cluster(
+                node_id=self.config.node_id,
+                hosts=self.config.cluster_hosts,
+                replica_n=self.config.replica_n,
+                holder=self.holder,
+            )
+        self.api = API(self.holder, cluster=self.cluster, stats=self.stats)
+        host, port = self._parse_bind(self.config.bind)
+        self.httpd = make_http_server(self.api, host, port, server=self)
+        self._threads: list[threading.Thread] = []
+        self._closing = threading.Event()
+
+    @staticmethod
+    def _parse_bind(bind: str) -> tuple[str, int]:
+        host, _, port = bind.rpartition(":")
+        return host or "localhost", int(port)
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def register_internal_routes(self, router):
+        if self.cluster is not None:
+            self.cluster.register_routes(router)
+
+    def open(self):
+        """(reference server.go:417 Open)"""
+        self.holder.open()
+        if self.cluster is not None:
+            self.cluster.open(self.api)
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        t.start()
+        self._threads.append(t)
+        self.logger.info(
+            f"pilosa-tpu listening on http://{self.config.bind}")
+        if self.cluster is not None and self.config.anti_entropy_interval > 0:
+            t = threading.Thread(target=self._monitor_anti_entropy,
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _monitor_anti_entropy(self):
+        """(server.go:514 monitorAntiEntropy)"""
+        while not self._closing.wait(self.config.anti_entropy_interval):
+            try:
+                self.cluster.sync_holder()
+            except Exception as e:
+                self.logger.error(f"anti-entropy sync failed: {e}")
+
+    def close(self):
+        self._closing.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self.cluster is not None:
+            self.cluster.close()
+        self.holder.close()

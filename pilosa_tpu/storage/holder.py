@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import os
+import re
 import shutil
 import threading
 
@@ -51,8 +52,9 @@ class Holder:
         """(holder.go:396 CreateIndex)"""
         with self._lock:
             if name in self.indexes:
-                raise ValueError(f"index already exists: {name}")
-            if not name or not name[0].isalpha() or name != name.lower():
+                raise FileExistsError(f"index already exists: {name}")
+            if not re.fullmatch(r"[a-z][a-z0-9_-]*", name):
+                # (pilosa.go validateName: ^[a-z][a-z0-9_-]*$)
                 raise ValueError(f"invalid index name: {name!r}")
             idx = Index(self._index_path(name), name, keys=keys,
                         track_existence=track_existence,

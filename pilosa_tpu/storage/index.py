@@ -107,8 +107,10 @@ class Index:
                      options: FieldOptions | None = None) -> Field:
         with self._lock:
             if name in self.fields:
-                raise IndexError_(f"field already exists: {name}")
-            if name.startswith("_") and name != EXISTENCE_FIELD_NAME:
+                raise FileExistsError(f"field already exists: {name}")
+            import re
+            if not re.fullmatch(r"[a-z][a-z0-9_-]*", name) and \
+                    name != EXISTENCE_FIELD_NAME:
                 raise IndexError_(f"invalid field name: {name}")
             f = self._make_field(name, options)
             f.save_meta()

@@ -1,0 +1,131 @@
+"""Pilosa 64-bit roaring file format codec (import/export compatibility).
+
+Implements the reference's serialization (roaring/roaring.go:1046 WriteTo,
+docs/architecture.md "Roaring bitmap storage format"): little-endian,
+cookie = 12348 (low 16 bits) | version<<16 | flags<<24, container count u32,
+then per container a descriptive header (key u64, type u16, cardinality-1
+u16), an offset header (u32 per container), and container data:
+
+* array (type 1): cardinality x u16
+* bitmap (type 2): 1024 x u64
+* run (type 3): run count u16 then [start, last] u16 pairs (inclusive)
+
+A fragment's bit (row, col) maps to position pos = row*SHARD_WIDTH + col;
+roaring keys are pos >> 16 and containers hold the low 16 bits
+(fragment.go:3087 pos, roaring key split).
+
+This is the Python half of the serializer; the C++ native module
+(pilosa_tpu/native) accelerates bulk parsing for the import path.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ..core import SHARD_WIDTH
+
+MAGIC = 12348
+TYPE_ARRAY = 1
+TYPE_BITMAP = 2
+TYPE_RUN = 3
+
+ARRAY_MAX_SIZE = 4096  # roaring.go:1927
+
+
+class RoaringFormatError(ValueError):
+    pass
+
+
+def unpack_roaring(data: bytes) -> tuple[np.ndarray, np.ndarray]:
+    """Parse a pilosa-roaring blob into (rows, shard-local cols) int64
+    arrays (roaring/roaring.go:1258 newRoaringIterator).  Raises
+    RoaringFormatError (a ValueError) on any malformed input."""
+    try:
+        return _unpack_roaring(data)
+    except (struct.error, IndexError) as e:
+        raise RoaringFormatError(f"malformed roaring data: {e}")
+
+
+def _unpack_roaring(data: bytes) -> tuple[np.ndarray, np.ndarray]:
+    if len(data) < 8:
+        raise RoaringFormatError("roaring data too short")
+    cookie = struct.unpack_from("<I", data, 0)[0]
+    if cookie & 0xFFFF != MAGIC:
+        raise RoaringFormatError(
+            f"bad roaring cookie: {cookie & 0xFFFF} (want {MAGIC})")
+    n_containers = struct.unpack_from("<I", data, 4)[0]
+    header_off = 8
+    offsets_off = header_off + n_containers * 12
+    if len(data) < offsets_off + n_containers * 4:
+        raise RoaringFormatError(
+            f"roaring data truncated: {n_containers} containers declared, "
+            f"{len(data)} bytes")
+
+    positions = []
+    for i in range(n_containers):
+        key, ctype, n_minus1 = struct.unpack_from(
+            "<QHH", data, header_off + i * 12)
+        n = n_minus1 + 1
+        off = struct.unpack_from("<I", data, offsets_off + i * 4)[0]
+        base = np.int64(key) << 16
+        if ctype == TYPE_ARRAY:
+            vals = np.frombuffer(data, dtype="<u2", count=n, offset=off)
+            positions.append(base + vals.astype(np.int64))
+        elif ctype == TYPE_BITMAP:
+            words = np.frombuffer(data, dtype="<u8", count=1024, offset=off)
+            bits = np.unpackbits(
+                words.view(np.uint8), bitorder="little")
+            positions.append(base + np.nonzero(bits)[0].astype(np.int64))
+        elif ctype == TYPE_RUN:
+            run_count = struct.unpack_from("<H", data, off)[0]
+            runs = np.frombuffer(data, dtype="<u2", count=run_count * 2,
+                                 offset=off + 2).reshape(run_count, 2)
+            for start, last in runs.astype(np.int64):
+                positions.append(base + np.arange(start, last + 1))
+        else:
+            raise RoaringFormatError(f"unknown container type {ctype}")
+
+    if not positions:
+        return (np.zeros(0, dtype=np.int64),) * 2
+    pos = np.concatenate(positions)
+    return pos // SHARD_WIDTH, pos % SHARD_WIDTH
+
+
+def pack_roaring(rows: np.ndarray, cols: np.ndarray) -> bytes:
+    """Serialize (row, shard-local col) bits to the pilosa-roaring format
+    (array/bitmap containers; runs are valid to read but not emitted,
+    mirroring Optimize()'s conservatism)."""
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    pos = np.unique(rows * SHARD_WIDTH + cols)
+    keys = pos >> 16
+    low = (pos & 0xFFFF).astype("<u2")
+
+    containers: list[tuple[int, int, np.ndarray | bytes]] = []
+    for key in np.unique(keys):
+        vals = low[keys == key]
+        if vals.size <= ARRAY_MAX_SIZE:
+            containers.append((int(key), TYPE_ARRAY, vals))
+        else:
+            words = np.zeros(1024, dtype="<u8")
+            v = vals.astype(np.int64)
+            np.bitwise_or.at(words, v >> 6,
+                             np.uint64(1) << (v & 63).astype(np.uint64))
+            containers.append((int(key), TYPE_BITMAP, words))
+
+    out = bytearray()
+    out += struct.pack("<I", MAGIC)
+    out += struct.pack("<I", len(containers))
+    for key, ctype, vals in containers:
+        n = vals.size if ctype == TYPE_ARRAY else \
+            int(np.bitwise_count(np.asarray(vals).view(np.uint64)).sum())
+        out += struct.pack("<QHH", key, ctype, n - 1)
+    offset = 8 + len(containers) * 12 + len(containers) * 4
+    for key, ctype, vals in containers:
+        out += struct.pack("<I", offset)
+        offset += vals.size * 2 if ctype == TYPE_ARRAY else 8192
+    for key, ctype, vals in containers:
+        out += vals.tobytes()
+    return bytes(out)

@@ -1,0 +1,6 @@
+"""Cross-cutting utilities: logger, stats, tracing (reference logger/,
+stats/, tracing/)."""
+
+from .logger import Logger, NopLogger  # noqa: F401
+from .stats import NopStatsClient, StatsClient  # noqa: F401
+from .tracing import GLOBAL_TRACER, NopTracer, Tracer  # noqa: F401

@@ -1,0 +1,33 @@
+"""Logger (reference logger/logger.go:25-107 Logger iface +
+std/verbose/nop impls)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+class Logger:
+    def __init__(self, verbose: bool = False, stream=None):
+        self.verbose = verbose
+        self.stream = stream or sys.stderr
+
+    def _emit(self, level: str, msg: str):
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S")
+        self.stream.write(f"{ts} {level} {msg}\n")
+        self.stream.flush()
+
+    def info(self, msg: str):
+        self._emit("INFO", msg)
+
+    def debug(self, msg: str):
+        if self.verbose:
+            self._emit("DEBUG", msg)
+
+    def error(self, msg: str):
+        self._emit("ERROR", msg)
+
+
+class NopLogger(Logger):
+    def _emit(self, level: str, msg: str):
+        pass

@@ -1,0 +1,111 @@
+"""Stats client (reference stats/stats.go:31-161 StatsClient iface).
+
+In-process counters/gauges/timings with tag support; snapshot() feeds both
+the expvar-style /debug/vars JSON and the Prometheus text exposition at
+/metrics (reference prometheus/prometheus.go)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+class StatsClient:
+    def __init__(self, tags: list[str] | None = None):
+        self.tags = tags or []
+        self._lock = threading.Lock()
+        self._counts: dict[str, float] = defaultdict(float)
+        self._gauges: dict[str, float] = {}
+        self._timings: dict[str, list[float]] = defaultdict(list)
+
+    def with_tags(self, *tags: str) -> "StatsClient":
+        child = StatsClient(self.tags + list(tags))
+        child._counts = self._counts
+        child._gauges = self._gauges
+        child._timings = self._timings
+        return child
+
+    def _key(self, name: str) -> str:
+        if not self.tags:
+            return name
+        return name + "{" + ",".join(sorted(self.tags)) + "}"
+
+    def count(self, name: str, value: float = 1, rate: float = 1.0):
+        with self._lock:
+            self._counts[self._key(name)] += value
+
+    def gauge(self, name: str, value: float, rate: float = 1.0):
+        with self._lock:
+            self._gauges[self._key(name)] = value
+
+    def timing(self, name: str, value_s: float, rate: float = 1.0):
+        with self._lock:
+            self._timings[self._key(name)].append(value_s)
+
+    def histogram(self, name: str, value: float, rate: float = 1.0):
+        self.timing(name, value, rate)
+
+    def set_value(self, name: str, value: str, rate: float = 1.0):
+        with self._lock:
+            self._gauges[self._key(name) + ":" + value] = 1
+
+    class _Timer:
+        def __init__(self, client, name):
+            self.client, self.name = client, name
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.client.timing(self.name, time.perf_counter() - self.t0)
+
+    def timer(self, name: str) -> "_Timer":
+        return self._Timer(self, name)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            timings = {
+                k: {"count": len(v), "sum": sum(v),
+                    "mean": sum(v) / len(v) if v else 0}
+                for k, v in self._timings.items()
+            }
+            return {"counts": dict(self._counts),
+                    "gauges": dict(self._gauges),
+                    "timings": timings}
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format for /metrics
+        (prometheus/prometheus.go:40)."""
+        lines = []
+
+        def fmt(name):
+            base, _, tags = name.partition("{")
+            base = "pilosa_tpu_" + base.replace(".", "_").replace("-", "_")
+            return base + ("{" + tags if tags else "")
+
+        snap = self.snapshot()
+        for k, v in sorted(snap["counts"].items()):
+            lines.append(f"# TYPE {fmt(k).split('{')[0]} counter")
+            lines.append(f"{fmt(k)} {v}")
+        for k, v in sorted(snap["gauges"].items()):
+            lines.append(f"# TYPE {fmt(k).split('{')[0]} gauge")
+            lines.append(f"{fmt(k)} {v}")
+        for k, t in sorted(snap["timings"].items()):
+            base = fmt(k).split("{")[0]
+            lines.append(f"# TYPE {base}_seconds summary")
+            lines.append(f"{base}_seconds_count {t['count']}")
+            lines.append(f"{base}_seconds_sum {t['sum']}")
+        return "\n".join(lines) + "\n"
+
+
+class NopStatsClient(StatsClient):
+    def count(self, *a, **k):
+        pass
+
+    def gauge(self, *a, **k):
+        pass
+
+    def timing(self, *a, **k):
+        pass

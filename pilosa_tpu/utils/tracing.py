@@ -1,0 +1,93 @@
+"""Tracing: vendor-neutral Tracer/Span facade (reference
+tracing/tracing.go:22-72) with an in-process recording tracer.
+
+HTTP propagation uses a single `X-Pilosa-Tpu-Trace` header carrying the
+trace id, so one distributed trace spans coordinator + remote nodes
+(reference http/client.go:1043 inject / handler.go:231 extract)."""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+
+TRACE_HEADER = "X-Pilosa-Tpu-Trace"
+
+
+class Span:
+    def __init__(self, tracer, name: str, trace_id: str, parent_id=None):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = uuid.uuid4().hex[:8]
+        self.parent_id = parent_id
+        self.start = time.time()
+        self.end: float | None = None
+        self.tags: dict = {}
+
+    def set_tag(self, key, value):
+        self.tags[key] = value
+
+    def finish(self):
+        self.end = time.time()
+        self.tracer._record(self)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "traceID": self.trace_id,
+            "spanID": self.span_id, "parentID": self.parent_id,
+            "start": self.start,
+            "durationMS": ((self.end or time.time()) - self.start) * 1e3,
+            "tags": self.tags,
+        }
+
+
+class Tracer:
+    """Records the most recent spans in a ring buffer, exposed at
+    /debug/traces."""
+
+    def __init__(self, max_spans: int = 1000):
+        self.max_spans = max_spans
+        self._spans: list[Span] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    def _record(self, span: Span):
+        with self._lock:
+            self._spans.append(span)
+            if len(self._spans) > self.max_spans:
+                self._spans = self._spans[-self.max_spans:]
+
+    def current_trace_id(self) -> str | None:
+        return getattr(self._local, "trace_id", None)
+
+    @contextmanager
+    def span(self, name: str, trace_id: str | None = None):
+        tid = trace_id or self.current_trace_id() or uuid.uuid4().hex[:16]
+        parent = getattr(self._local, "span_id", None)
+        s = Span(self, name, tid, parent)
+        prev = (getattr(self._local, "trace_id", None),
+                getattr(self._local, "span_id", None))
+        self._local.trace_id = tid
+        self._local.span_id = s.span_id
+        try:
+            yield s
+        finally:
+            s.finish()
+            self._local.trace_id, self._local.span_id = prev
+
+    def spans(self, trace_id: str | None = None) -> list[dict]:
+        with self._lock:
+            out = [s.to_dict() for s in self._spans]
+        if trace_id:
+            out = [s for s in out if s["traceID"] == trace_id]
+        return out
+
+
+GLOBAL_TRACER = Tracer()
+
+
+class NopTracer(Tracer):
+    def _record(self, span):
+        pass
