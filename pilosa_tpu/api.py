@@ -80,9 +80,12 @@ class API:
         self._validate("Query")
         if self.stats:
             self.stats.count("query", 1)
-        if self.cluster is not None:
-            return self.cluster.execute(index, query, shards)
-        return self.executor.execute(index, query, shards)
+        from .utils.tracing import GLOBAL_TRACER
+        with GLOBAL_TRACER.span("api.Query") as span:
+            span.set_tag("index", index)
+            if self.cluster is not None:
+                return self.cluster.execute(index, query, shards)
+            return self.executor.execute(index, query, shards)
 
     # -- DDL ---------------------------------------------------------------
 
@@ -170,15 +173,16 @@ class API:
             idx.add_existence(cols)
 
     def import_values(self, index: str, field: str,
-                      column_ids=None, values=None):
+                      column_ids=None, values=None, clear: bool = False):
         self._validate("ImportValue")
         idx, f = self._index_field(index, field)
         cols = np.asarray(column_ids or [], dtype=np.int64)
         vals = np.asarray(values or [], dtype=np.int64)
-        if cols.size != vals.size:
+        if not clear and cols.size != vals.size:
             raise ApiError("columnIDs and values length mismatch")
-        f.import_values(cols, vals)
-        idx.add_existence(cols)
+        f.import_values(cols, vals, clear=clear)
+        if not clear:
+            idx.add_existence(cols)
 
     def import_roaring(self, index: str, field: str, shard: int,
                        views: dict[str, bytes], clear: bool = False):

@@ -82,6 +82,9 @@ def cmd_import(args) -> int:
             return
         if args.field_type == "int":
             payload = {"columnIDs": cols, "values": vals}
+            if args.clear:
+                payload["clear"] = True
+                payload.pop("values")
         else:
             payload = {"rowIDs": rows, "columnIDs": cols}
             if any(tss):

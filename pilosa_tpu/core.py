@@ -17,6 +17,8 @@ branching cost of adaptive representations dwarfs the bandwidth saving on TPU.
 
 from __future__ import annotations
 
+import re
+
 # Shard geometry — compile-time constant, like the reference's build-tag
 # selected exponent (shardwidth/20.go: Exponent = 20).
 SHARD_WIDTH_EXP = 20
@@ -50,6 +52,17 @@ VIEW_BSI_GROUP_PREFIX = "bsig_"
 
 # Cluster-level partitioning (cluster.go:44 defaultPartitionN).
 DEFAULT_PARTITION_N = 256
+
+
+_NAME_RE = re.compile(r"[a-z][a-z0-9_-]*")
+
+
+def validate_name(name: str, kind: str = "name") -> str:
+    """Index/field name rule (reference pilosa.go validateName:
+    ^[a-z][a-z0-9_-]*$, max 64 chars)."""
+    if not _NAME_RE.fullmatch(name) or len(name) > 64:
+        raise ValueError(f"invalid {kind}: {name!r}")
+    return name
 
 
 def pos(row_id: int, col: int) -> int:

@@ -34,9 +34,17 @@ class ExecutionError(ValueError):
 
 
 class Executor:
-    def __init__(self, holder):
+    def __init__(self, holder, mesh=None, use_mesh: bool | None = None):
+        """``mesh``: a jax Mesh to execute shard batches on (stacked
+        shard_map execution with ICI reductions, parallel/mesh_exec.py).
+        When None, per-shard dispatch is used.  ``use_mesh=True`` with no
+        mesh builds one over all local devices."""
         self.holder = holder
         self.compiler = PlanCompiler()
+        self.mesh_exec = None
+        if mesh is not None or use_mesh:
+            from ..parallel.mesh_exec import MeshExecutor
+            self.mesh_exec = MeshExecutor(mesh)
 
     # -- entry point (executor.go:113 Execute) -----------------------------
 
@@ -92,11 +100,16 @@ class Executor:
 
     def _execute_bitmap(self, index: str, c: Call, shards) -> RowResult:
         plan = self._resolve(index, c)
-        segments = {}
-        for shard in shards:
-            segments[shard] = self.compiler.execute_shard(
-                plan, self.holder, index, shard)
-        return RowResult(segments)
+        return RowResult(self._plan_segments(plan, index, shards))
+
+    def _plan_segments(self, plan, index: str, shards) -> dict:
+        if self.mesh_exec is not None:
+            return self.mesh_exec.segments(plan, self.holder, index, shards)
+        return {
+            shard: self.compiler.execute_shard(plan, self.holder, index,
+                                               shard)
+            for shard in shards
+        }
 
     # -- aggregations ------------------------------------------------------
 
@@ -105,6 +118,8 @@ class Executor:
         if len(c.children) != 1:
             raise ExecutionError("Count() requires one input")
         plan = self._resolve(index, c.children[0])
+        if self.mesh_exec is not None:
+            return self.mesh_exec.count(plan, self.holder, index, shards)
         counts = [
             self.compiler.execute_shard(plan, self.holder, index, shard,
                                         reducer="count")
@@ -131,11 +146,7 @@ class Executor:
         if not c.children:
             return None
         plan = self._resolve(index, c.children[0])
-        return {
-            shard: self.compiler.execute_shard(plan, self.holder, index,
-                                               shard)
-            for shard in shards
-        }
+        return self._plan_segments(plan, index, shards)
 
     def _execute_sum(self, index: str, c: Call, shards) -> ValCount:
         """(executor.go:406 executeSum + fragment.go:1111 sum)"""

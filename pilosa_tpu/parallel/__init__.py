@@ -1,0 +1,5 @@
+"""Distribution: shard placement + device-mesh execution + cluster
+(reference cluster.go / executor.go mapReduce, rebuilt on jax.sharding)."""
+
+from .placement import JmpHasher, ModHasher, Placement, jump_hash  # noqa: F401
+from .mesh_exec import MeshExecutor, default_mesh  # noqa: F401

@@ -1,0 +1,171 @@
+"""Multi-device shard execution over a jax Mesh.
+
+The reference fans per-shard jobs to a goroutine pool and a star reduce
+(executor.go:2455 mapReduce, :2482 coordinator-side reduce).  Here shards
+with identical plan input shapes are STACKED into [S, rows, W] tensors,
+sharded over a 1-d "shards" mesh axis, and the whole batch executes as one
+XLA computation under shard_map: each device runs the vmapped plan on its
+local shard block and cross-shard reductions (Count, per-row counts for
+TopN) ride ICI collectives (psum) instead of host gather — the star reduce
+becomes an all-reduce.
+
+On a single device this degrades gracefully to one stacked call (still
+better than per-shard dispatch given the ~100 ms tunnel round-trip floor).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import bitset
+from ..executor.plan import eval_plan, plan_inputs
+
+SHARD_AXIS = "shards"
+
+
+def default_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), axis_names=(SHARD_AXIS,))
+
+
+class MeshExecutor:
+    """Executes resolved plans over stacked shard groups on a device mesh."""
+
+    def __init__(self, mesh: Mesh | None = None):
+        self.mesh = mesh or default_mesh()
+        self.n_devices = self.mesh.devices.size
+        self._cache: dict = {}
+
+    # -- compiled executables ---------------------------------------------
+
+    def _compiled(self, plan, input_keys, shapes, reducer):
+        key = (repr(plan), tuple(input_keys), tuple(shapes), reducer,
+               id(self.mesh))
+        fn = self._cache.get(key)
+        if fn is not None:
+            return fn
+
+        # input_keys here are only the PRESENT fragments; missing ones are
+        # omitted from the arg list entirely (shard_map specs must map 1:1
+        # to array args)
+        def per_shard(*arrays):
+            frags = dict(zip(input_keys, arrays))
+            return eval_plan(plan, frags)
+
+        vmapped = jax.vmap(per_shard)
+
+        if reducer == "count":
+            def block_fn(*arrays):
+                segs = vmapped(*arrays)  # [S_local, W]
+                local = jnp.sum(
+                    jax.lax.population_count(segs).astype(jnp.int32))
+                return jax.lax.psum(local, axis_name=SHARD_AXIS)
+
+            out_specs = P()
+        elif reducer == "row_counts":
+            # per-(shard-row) popcounts of the first input fragment masked
+            # by the plan result — TopN phase 1, reduced over shards on ICI
+            def block_fn(*arrays):
+                segs = vmapped(*arrays)            # [S_local, W]
+                frag = arrays[0]                   # [S_local, rows, W]
+                masked = frag & segs[:, None, :] if segs is not None else frag
+                counts = jnp.sum(
+                    jax.lax.population_count(masked).astype(jnp.int32),
+                    axis=(0, 2))                   # [rows]
+                return jax.lax.psum(counts, axis_name=SHARD_AXIS)
+
+            out_specs = P()
+        else:
+            def block_fn(*arrays):
+                return vmapped(*arrays)            # [S_local, W]
+
+            out_specs = P(SHARD_AXIS)
+
+        in_specs = tuple(P(SHARD_AXIS) for _ in shapes)
+        from jax.experimental.shard_map import shard_map
+
+        fn = jax.jit(shard_map(
+            block_fn, mesh=self.mesh,
+            in_specs=in_specs, out_specs=out_specs))
+        self._cache[key] = fn
+        return fn
+
+    # -- shard grouping ----------------------------------------------------
+
+    def _gather_inputs(self, plan, holder, index, shards):
+        """Group shards by input-shape signature; returns
+        [(shard_list, input_keys, stacked_arrays, shapes)]."""
+        keys = plan_inputs(plan)
+        groups: dict[tuple, list[tuple[int, list]]] = {}
+        for shard in shards:
+            arrays = []
+            for field, view in keys:
+                frag = holder.fragment(index, field, view, shard)
+                arrays.append(None if frag is None else frag.device())
+            sig = tuple(None if a is None else a.shape for a in arrays)
+            groups.setdefault(sig, []).append((shard, arrays))
+        out = []
+        for sig, members in groups.items():
+            shard_list = [m[0] for m in members]
+            stacked = []
+            for i, shape in enumerate(sig):
+                if shape is None:
+                    stacked.append(None)
+                else:
+                    stacked.append([m[1][i] for m in members])
+            out.append((shard_list, keys, stacked, sig))
+        return out
+
+    def _pad_and_place(self, arrays_list, shape, n: int):
+        """Stack n member arrays, pad to a multiple of n_devices, and place
+        sharded over the mesh axis."""
+        pad = (-n) % self.n_devices
+        mats = list(arrays_list)
+        if pad:
+            zero = jnp.zeros(shape, dtype=jnp.uint32)
+            mats += [zero] * pad
+        stacked = jnp.stack(mats)
+        sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        return jax.device_put(stacked, sharding)
+
+    # -- public entry points ----------------------------------------------
+
+    def count(self, plan, holder, index, shards) -> int:
+        total = 0
+        for shard_list, keys, stacked, sig in self._gather_inputs(
+                plan, holder, index, shards):
+            if all(s is None for s in sig):
+                continue  # no fragments -> plan evaluates to empty
+            n = len(shard_list)
+            present = [(k, a, s) for k, a, s in zip(keys, stacked, sig)
+                       if s is not None]
+            placed = [self._pad_and_place(a, s, n) for _, a, s in present]
+            fn = self._compiled(plan, tuple(k for k, _, _ in present),
+                                tuple(s for _, _, s in present), "count")
+            total += int(fn(*placed))
+        return total
+
+    def segments(self, plan, holder, index, shards) -> dict[int, jax.Array]:
+        from ..core import SHARD_WORDS
+
+        out: dict[int, jax.Array] = {}
+        for shard_list, keys, stacked, sig in self._gather_inputs(
+                plan, holder, index, shards):
+            if all(s is None for s in sig):
+                zero = jnp.zeros(SHARD_WORDS, dtype=jnp.uint32)
+                for shard in shard_list:
+                    out[shard] = zero
+                continue
+            n = len(shard_list)
+            present = [(k, a, s) for k, a, s in zip(keys, stacked, sig)
+                       if s is not None]
+            placed = [self._pad_and_place(a, s, n) for _, a, s in present]
+            fn = self._compiled(plan, tuple(k for k, _, _ in present),
+                                tuple(s for _, _, s in present), None)
+            segs = fn(*placed)
+            for i, shard in enumerate(shard_list):
+                out[shard] = segs[i]
+        return out

@@ -18,6 +18,7 @@ from urllib.parse import parse_qs, urlparse
 
 from .. import __version__
 from ..api import API, ApiError, ConflictError, DisallowedError, NotFoundError
+from ..utils.tracing import GLOBAL_TRACER, TRACE_HEADER
 from ..executor import RowResult, ValCount, RowIdentifiers
 from ..executor.results import GroupCount, Pair
 
@@ -134,9 +135,10 @@ def build_router(api: API, server=None) -> Router:
 
     def post_import(req, args):
         body = req.json()
-        if "values" in body:
+        if "values" in body or (body.get("clear") and "rowIDs" not in body):
             api.import_values(args["index"], args["field"],
-                              body.get("columnIDs"), body.get("values"))
+                              body.get("columnIDs"), body.get("values"),
+                              clear=body.get("clear", False))
         else:
             api.import_bits(args["index"], args["field"],
                             body.get("rowIDs"), body.get("columnIDs"),
@@ -228,6 +230,7 @@ class _HandlerClass(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         self.body = self.rfile.read(length) if length else b""
         fn, args = self.router.match(method, parsed.path)
+        trace_id = self.headers.get(TRACE_HEADER)  # handler.go:231 extract
         try:
             if fn is None:
                 self._send(404, {"error": f"path not found: {parsed.path}"})
@@ -235,7 +238,9 @@ class _HandlerClass(BaseHTTPRequestHandler):
             if fn == "method_not_allowed":
                 self._send(405, {"error": "method not allowed"})
                 return
-            out = fn(self, args)
+            with GLOBAL_TRACER.span(f"{method} {parsed.path}",
+                                    trace_id=trace_id):
+                out = fn(self, args)
             if isinstance(out, tuple):
                 ctype, payload = out
                 self._send_raw(200, ctype, payload.encode()

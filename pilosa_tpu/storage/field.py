@@ -341,12 +341,24 @@ class Field:
                     frag.bulk_import(vr[sel], vc[sel] % SHARD_WIDTH,
                                      clear=clear)
 
-    def import_values(self, cols: np.ndarray, values: np.ndarray) -> None:
-        """Bulk BSI import (field.go:1287 importValue)."""
+    def import_values(self, cols: np.ndarray, values: np.ndarray,
+                      clear: bool = False) -> None:
+        """Bulk BSI import (field.go:1287 importValue); ``clear`` removes
+        the columns' values instead."""
         self._require_int()
         cols = np.asarray(cols, dtype=np.int64)
         values = np.asarray(values, dtype=np.int64)
         if cols.size == 0:
+            return
+        if clear:
+            view = self.views.get(self.bsi_view_name())
+            if view is None:
+                return
+            shards = cols // SHARD_WIDTH
+            for shard in np.unique(shards):
+                frag = view.fragment(int(shard))
+                if frag is not None:
+                    frag.clear_values(cols[shards == shard] % SHARD_WIDTH)
             return
         base_values = values - self.options.base
         required = max(

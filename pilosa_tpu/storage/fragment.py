@@ -349,6 +349,22 @@ class Fragment:
             self._dirty_data = True
             self.snapshot()
 
+    def clear_values(self, cols: np.ndarray) -> None:
+        """Remove columns' values entirely (exists+sign+magnitude cleared) —
+        the clear half of importValue (fragment.go:2205 importValue with
+        clear)."""
+        cols = np.asarray(cols, dtype=np.int64)
+        if cols.size == 0 or self.n_rows == 0:
+            return
+        with self._lock:
+            w, bit = bitset.word_bit_np(cols)
+            mask = np.zeros(SHARD_WORDS, dtype=np.uint32)
+            np.bitwise_or.at(mask, w, bit)
+            self.words &= ~mask
+            self._device_dirty = True
+            self._dirty_data = True
+            self.snapshot()
+
     # -- reads -------------------------------------------------------------
 
     def row(self, row_id: int) -> np.ndarray:

@@ -3,7 +3,6 @@
 from __future__ import annotations
 
 import os
-import re
 import shutil
 import threading
 
@@ -53,9 +52,8 @@ class Holder:
         with self._lock:
             if name in self.indexes:
                 raise FileExistsError(f"index already exists: {name}")
-            if not re.fullmatch(r"[a-z][a-z0-9_-]*", name):
-                # (pilosa.go validateName: ^[a-z][a-z0-9_-]*$)
-                raise ValueError(f"invalid index name: {name!r}")
+            from ..core import validate_name
+            validate_name(name, "index name")
             idx = Index(self._index_path(name), name, keys=keys,
                         track_existence=track_existence,
                         max_op_n=self.max_op_n, create=True)

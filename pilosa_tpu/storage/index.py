@@ -108,10 +108,12 @@ class Index:
         with self._lock:
             if name in self.fields:
                 raise FileExistsError(f"field already exists: {name}")
-            import re
-            if not re.fullmatch(r"[a-z][a-z0-9_-]*", name) and \
-                    name != EXISTENCE_FIELD_NAME:
-                raise IndexError_(f"invalid field name: {name}")
+            if name != EXISTENCE_FIELD_NAME:
+                from ..core import validate_name
+                try:
+                    validate_name(name, "field name")
+                except ValueError as e:
+                    raise IndexError_(str(e))
             f = self._make_field(name, options)
             f.save_meta()
             self.fields[name] = f

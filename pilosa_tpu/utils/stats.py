@@ -21,6 +21,7 @@ class StatsClient:
 
     def with_tags(self, *tags: str) -> "StatsClient":
         child = StatsClient(self.tags + list(tags))
+        child._lock = self._lock  # shared metrics need the shared lock
         child._counts = self._counts
         child._gauges = self._gauges
         child._timings = self._timings

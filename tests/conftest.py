@@ -4,12 +4,21 @@ exercised without TPU hardware.  Must run before jax initialises."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU regardless of the ambient platform.  The dev environment's
+# sitecustomize imports jax at interpreter startup with JAX_PLATFORMS=axon
+# (the TPU tunnel) already latched into jax's config, so the env var alone
+# is too late — override the config directly before any backend
+# initialises (backends init lazily on first device use).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
