@@ -43,6 +43,17 @@ HASH_BLOCK_SIZE = 100
 # rewrite (fragment.go:84 DefaultFragmentMaxOpN = 10000).
 DEFAULT_FRAGMENT_MAX_OP_N = 10000
 
+# Highest row id a fragment will accept (configurable via
+# Config.max_row_id / PILOSA_TPU_MAX_ROW_ID).  The dense representation
+# allocates n_rows*SHARD_WORDS*4 bytes per fragment, so an unbounded row id
+# from a hostile import (rowIDs=[2**40]) would attempt a terabyte-scale
+# allocation; the reference is sparse in row space and has no such hazard
+# (roaring row keys are just u48 container keys).  2^20 rows caps a single
+# fragment's dense worst case at 128 GiB logical — combined with doubling
+# growth and sparse snapshots, real indexes stay far below it; raise the
+# cap explicitly for wider row spaces.
+DEFAULT_MAX_ROW_ID = (1 << 20) - 1
+
 # Reserved existence-field name (index.go: existenceFieldName "_exists").
 EXISTENCE_FIELD_NAME = "_exists"
 

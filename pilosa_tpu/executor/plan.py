@@ -15,7 +15,7 @@ Plan node types double as cache keys via their repr.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from datetime import datetime, timedelta
+from datetime import datetime, timedelta, timezone
 from typing import Any
 
 import jax
@@ -166,7 +166,8 @@ class Resolver:
             to_time = tq.parse_time(to_arg)
         else:
             # executor.go:1506: now + 1 day when "to" omitted
-            to_time = datetime.utcnow() + timedelta(days=1)
+            to_time = (datetime.now(timezone.utc).replace(tzinfo=None)
+                       + timedelta(days=1))
         views = tuple(tq.views_by_time_range(
             VIEW_STANDARD, from_time, to_time, quantum))
         if not views:

@@ -37,6 +37,17 @@ class MeshExecutor:
     def __init__(self, mesh: Mesh | None = None):
         self.mesh = mesh or default_mesh()
         self.n_devices = self.mesh.devices.size
+        # Fragment mirrors must live on the mesh's platform (e.g. a virtual
+        # CPU mesh while the default backend is a TPU).  When the mesh IS on
+        # the default platform we stage with target=None so the mesh path
+        # and the per-shard executor share one cached upload per fragment
+        # instead of holding two copies in device memory.
+        stage = self.mesh.devices.flat[0]
+        cfg_default = jax.config.jax_default_device
+        default_platform = (cfg_default.platform if cfg_default is not None
+                            else jax.devices()[0].platform)
+        self.stage_device = None if stage.platform == default_platform \
+            else stage
         self._cache: dict = {}
 
     # -- compiled executables ---------------------------------------------
@@ -85,9 +96,7 @@ class MeshExecutor:
             out_specs = P(SHARD_AXIS)
 
         in_specs = tuple(P(SHARD_AXIS) for _ in shapes)
-        from jax.experimental.shard_map import shard_map
-
-        fn = jax.jit(shard_map(
+        fn = jax.jit(jax.shard_map(
             block_fn, mesh=self.mesh,
             in_specs=in_specs, out_specs=out_specs))
         self._cache[key] = fn
@@ -104,7 +113,9 @@ class MeshExecutor:
             arrays = []
             for field, view in keys:
                 frag = holder.fragment(index, field, view, shard)
-                arrays.append(None if frag is None else frag.device())
+                arrays.append(
+                    None if frag is None
+                    else frag.device(self.stage_device))
             sig = tuple(None if a is None else a.shape for a in arrays)
             groups.setdefault(sig, []).append((shard, arrays))
         out = []
@@ -125,7 +136,8 @@ class MeshExecutor:
         pad = (-n) % self.n_devices
         mats = list(arrays_list)
         if pad:
-            zero = jnp.zeros(shape, dtype=jnp.uint32)
+            zero = jax.device_put(
+                np.zeros(shape, dtype=np.uint32), self.stage_device)
             mats += [zero] * pad
         stacked = jnp.stack(mats)
         sharding = NamedSharding(self.mesh, P(SHARD_AXIS))

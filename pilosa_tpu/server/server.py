@@ -25,6 +25,8 @@ class Config:
     data_dir: str = "~/.pilosa_tpu"
     bind: str = "localhost:10101"
     max_op_n: int = 10000
+    # Highest row id accepted by any fragment (core.DEFAULT_MAX_ROW_ID).
+    max_row_id: int = 0  # 0 = keep default
     # cluster
     node_id: str = "node0"
     cluster_hosts: list = dataclasses.field(default_factory=list)
@@ -53,6 +55,7 @@ class Config:
             "PILOSA_TPU_ANTI_ENTROPY_INTERVAL": (
                 "anti_entropy_interval", float),
             "PILOSA_TPU_VERBOSE": ("verbose", lambda s: s == "true"),
+            "PILOSA_TPU_MAX_ROW_ID": ("max_row_id", int),
         }
         for env, (attr, conv) in env_map.items():
             if env in os.environ:
@@ -74,6 +77,7 @@ class Config:
         cfg = cls()
         mapping = {
             "data-dir": "data_dir", "bind": "bind", "max-op-n": "max_op_n",
+            "max-row-id": "max_row_id",
         }
         for key, attr in mapping.items():
             if key in doc:
@@ -95,6 +99,9 @@ class Server:
         self.config = config or Config()
         self.logger = Logger(verbose=self.config.verbose)
         self.stats = StatsClient()
+        if self.config.max_row_id > 0:
+            from ..storage.fragment import Fragment
+            Fragment.row_id_cap = self.config.max_row_id
         data_dir = os.path.expanduser(self.config.data_dir)
         self.holder = Holder(data_dir, max_op_n=self.config.max_op_n)
         self.cluster = None

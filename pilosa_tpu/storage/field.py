@@ -122,10 +122,12 @@ class Field:
         if self.options.type == FIELD_TYPE_INT:
             if self.options.base == 0:
                 self.options.base = bsi_base(self.options.min, self.options.max)
-            if self.options.bit_depth == 0:
-                self.options.bit_depth = max(
-                    bit_depth(self.options.min - self.options.base),
-                    bit_depth(self.options.max - self.options.base), 1)
+            # bit_depth intentionally starts at 0 and grows with the values
+            # actually written (field.go:1088-1105), NOT with the declared
+            # min/max: BSI range scans are O(bit_depth), so a field declared
+            # wide but used narrow stays cheap.  Declared-range enforcement
+            # on writes (_check_value) keeps options.min/max sound for the
+            # planner's shortcut paths.
         if self.options.type == FIELD_TYPE_TIME:
             tq.validate_quantum(self.options.time_quantum)
 
@@ -260,20 +262,34 @@ class Field:
         if self.options.type != FIELD_TYPE_INT:
             raise FieldError(f"field {self.name!r} is not an int field")
 
+    def _check_value(self, value: int):
+        """Declared-range enforcement (field.go:1082-1086
+        ErrBSIGroupValueTooLow/High).  This is what makes options.min/max
+        true invariants of the stored data, which the planner's
+        full-encompass shortcuts rely on (plan.py _resolve_bsi)."""
+        if value < self.options.min:
+            raise FieldError(
+                f"bsigroup value too low: {value} < min {self.options.min}")
+        if value > self.options.max:
+            raise FieldError(
+                f"bsigroup value too high: {value} > max {self.options.max}")
+
     def set_value(self, col: int, value: int) -> bool:
         """(field.go:1077 SetValue): store value-base; grow bit depth as
         needed (field.go:1088-1105)."""
         self._require_int()
+        self._check_value(value)
         base_value = value - self.options.base
-        required = max(bit_depth(base_value), 1)
-        if required > self.options.bit_depth:
-            self.options.bit_depth = required
-            self.save_meta()
+        with self._lock:
+            required = max(bit_depth(base_value), 1)
+            if required > self.options.bit_depth:
+                self.options.bit_depth = required
+                self.save_meta()
+            depth = self.options.bit_depth
         shard = col // SHARD_WIDTH
         frag = self._create_view_if_not_exists(self.bsi_view_name()) \
             .create_fragment_if_not_exists(shard)
-        return frag.set_value(col % SHARD_WIDTH, self.options.bit_depth,
-                              base_value)
+        return frag.set_value(col % SHARD_WIDTH, depth, base_value)
 
     def value(self, col: int):
         """(field.go:1060 Value) -> (value, exists)."""
@@ -360,13 +376,17 @@ class Field:
                 if frag is not None:
                     frag.clear_values(cols[shards == shard] % SHARD_WIDTH)
             return
+        self._check_value(int(values.min()))
+        self._check_value(int(values.max()))
         base_values = values - self.options.base
-        required = max(
-            bit_depth(int(base_values.min())),
-            bit_depth(int(base_values.max())), 1)
-        if required > self.options.bit_depth:
-            self.options.bit_depth = required
-            self.save_meta()
+        with self._lock:
+            required = max(
+                bit_depth(int(base_values.min())),
+                bit_depth(int(base_values.max())), 1)
+            if required > self.options.bit_depth:
+                self.options.bit_depth = required
+                self.save_meta()
+            depth = self.options.bit_depth
         view = self._create_view_if_not_exists(self.bsi_view_name())
         shards = cols // SHARD_WIDTH
         for shard in np.unique(shards):
@@ -374,4 +394,4 @@ class Field:
             frag = view.create_fragment_if_not_exists(int(shard))
             # merge with existing values in the fragment
             frag.import_values(cols[sel] % SHARD_WIDTH, base_values[sel],
-                               self.options.bit_depth)
+                               depth)

@@ -26,6 +26,7 @@ import numpy as np
 
 from ..core import (
     DEFAULT_FRAGMENT_MAX_OP_N,
+    DEFAULT_MAX_ROW_ID,
     HASH_BLOCK_SIZE,
     SHARD_WIDTH,
     SHARD_WORDS,
@@ -59,7 +60,7 @@ class Fragment:
         self.max_op_n = max_op_n
 
         self.words = np.zeros((0, SHARD_WORDS), dtype=np.uint32)
-        self._device = None       # cached jax.Array mirror
+        self._mirrors = {}        # device -> cached jax.Array mirror
         self._device_dirty = True
         self._op_n = 0
         self._dirty_data = False  # mutated since last snapshot?
@@ -91,6 +92,16 @@ class Fragment:
                 raise ValueError(
                     f"fragment file {self.path} has {words} words/row, "
                     f"expected {SHARD_WORDS}")
+            # Row capacity doubles, so a legitimately-written snapshot never
+            # declares more than 2*(cap+1) rows; beyond that the header is
+            # corrupt or was written under a larger max_row_id config — an
+            # explicit error either way, instead of a terabyte np.zeros.
+            if n_rows > 2 * (self.row_id_cap + 1):
+                raise ValueError(
+                    f"fragment file {self.path} declares {n_rows} rows, "
+                    f"above the configured max_row_id {self.row_id_cap}; "
+                    f"raise max_row_id if this data was written with a "
+                    f"larger cap")
             self.words = np.zeros((n_rows, words), dtype=np.uint32)
             if nnz:
                 flat = self.words.reshape(-1)
@@ -100,10 +111,16 @@ class Fragment:
                 buf = f.read()
             for off in range(0, len(buf) - len(buf) % _OP.size, _OP.size):
                 op, row, col = _OP.unpack_from(buf, off)
-                if op == _OP_SET:
-                    self._set_bit_mem(row, col)
-                else:
-                    self._clear_bit_mem(row, col)
+                try:
+                    if op == _OP_SET:
+                        self._set_bit_mem(row, col)
+                    else:
+                        self._clear_bit_mem(row, col)
+                except ValueError as e:
+                    raise ValueError(
+                        f"replaying WAL {self._wal_path()}: {e}; raise "
+                        f"max_row_id if this data was written with a larger "
+                        f"cap") from e
             self._op_n = len(buf) // _OP.size
         self._wal_file = open(self._wal_path(), "ab", buffering=0)
 
@@ -114,7 +131,7 @@ class Fragment:
                     self.snapshot()
                 self._wal_file.close()
                 self._wal_file = None
-            self._device = None
+            self._mirrors.clear()
 
     def snapshot(self):
         """Rewrite the snapshot file and truncate the WAL
@@ -153,16 +170,25 @@ class Fragment:
         nz = np.nonzero(self.words.any(axis=1))[0]
         return int(nz[-1]) if nz.size else 0
 
+    # Configurable guard against hostile row ids forcing terabyte-scale
+    # dense allocations (see core.DEFAULT_MAX_ROW_ID).  Class-level so the
+    # server config can raise it for every fragment at once.
+    row_id_cap = DEFAULT_MAX_ROW_ID
+
     def _ensure_rows(self, row_id: int):
         if row_id < self.n_rows:
             return
+        if row_id > self.row_id_cap:
+            raise ValueError(
+                f"row id {row_id} exceeds the configured maximum "
+                f"{self.row_id_cap} (max_row_id)")
         new_rows = max(_MIN_ROWS, self.n_rows)
         while new_rows <= row_id:
             new_rows *= 2
         grown = np.zeros((new_rows, SHARD_WORDS), dtype=np.uint32)
         grown[: self.n_rows] = self.words
         self.words = grown
-        self._device = None
+        self._mirrors.clear()
         self._device_dirty = True
 
     # -- mutation ----------------------------------------------------------
@@ -377,17 +403,30 @@ class Fragment:
     def row_columns(self, row_id: int) -> np.ndarray:
         return bitset.unpack_columns(self.row(row_id))
 
-    def device(self):
+    def device(self, target=None):
         """The HBM-resident mirror (uploads if stale).  This is the query hot
         path's input — equivalent to the mmap'd storage the reference queries
-        against (fragment.go:311)."""
+        against (fragment.go:311).
+
+        ``target``: an optional jax Device to place the mirror on.  Mesh
+        executors pass a device from their own mesh when the mesh's platform
+        differs from the default backend (e.g. a virtual CPU mesh under a
+        TPU default); mirrors are cached per target.  ``None`` stays
+        UNCOMMITTED (and is its own cache key) so results can combine freely
+        with mesh-sharded arrays — callers on the default platform should
+        pass None to share this entry rather than duplicating the upload
+        under a concrete-device key."""
         import jax
 
         with self._lock:
-            if self._device is None or self._device_dirty:
-                self._device = jax.device_put(self.words)
+            if self._device_dirty:
+                self._mirrors.clear()
                 self._device_dirty = False
-            return self._device
+            mirror = self._mirrors.get(target)
+            if mirror is None:
+                mirror = jax.device_put(self.words, target)
+                self._mirrors[target] = mirror
+            return mirror
 
     # -- anti-entropy block checksums (fragment.go:1778 Blocks) ------------
 

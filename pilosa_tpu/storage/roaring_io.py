@@ -14,8 +14,9 @@ A fragment's bit (row, col) maps to position pos = row*SHARD_WIDTH + col;
 roaring keys are pos >> 16 and containers hold the low 16 bits
 (fragment.go:3087 pos, roaring key split).
 
-This is the Python half of the serializer; the C++ native module
-(pilosa_tpu/native) accelerates bulk parsing for the import path.
+All parsing is vectorized numpy — container payloads are decoded with
+frombuffer/unpackbits, so the Python-level loop is per container, not per
+bit.
 """
 
 from __future__ import annotations
@@ -24,7 +25,7 @@ import struct
 
 import numpy as np
 
-from ..core import SHARD_WIDTH
+from ..core import SHARD_WIDTH, SHARD_WIDTH_EXP
 
 MAGIC = 12348
 TYPE_ARRAY = 1
@@ -44,7 +45,7 @@ def unpack_roaring(data: bytes) -> tuple[np.ndarray, np.ndarray]:
     RoaringFormatError (a ValueError) on any malformed input."""
     try:
         return _unpack_roaring(data)
-    except (struct.error, IndexError) as e:
+    except (struct.error, IndexError, OverflowError) as e:
         raise RoaringFormatError(f"malformed roaring data: {e}")
 
 
@@ -63,10 +64,22 @@ def _unpack_roaring(data: bytes) -> tuple[np.ndarray, np.ndarray]:
             f"roaring data truncated: {n_containers} containers declared, "
             f"{len(data)} bytes")
 
+    # Container keys are the high 48 bits of a bit position; reject any key
+    # implying a row id above the configured cap BEFORE the signed shift —
+    # a key >= 2**47 would overflow int64 and silently alias into valid
+    # rows, bypassing the cap (and the allocation guard behind it).
+    from .fragment import Fragment
+
+    max_key = (((Fragment.row_id_cap + 1) << SHARD_WIDTH_EXP) - 1) >> 16
+
     positions = []
     for i in range(n_containers):
         key, ctype, n_minus1 = struct.unpack_from(
             "<QHH", data, header_off + i * 12)
+        if key > max_key:
+            raise RoaringFormatError(
+                f"roaring container key {key} implies a row id above the "
+                f"configured maximum {Fragment.row_id_cap}")
         n = n_minus1 + 1
         off = struct.unpack_from("<I", data, offsets_off + i * 4)[0]
         base = np.int64(key) << 16

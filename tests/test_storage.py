@@ -170,11 +170,42 @@ def test_field_int_values_and_base():
 
 
 def test_field_int_auto_depth_growth():
-    f = Field(None, "i", "f", FieldOptions(type="int", min=0, max=10))
+    f = Field(None, "i", "f", FieldOptions(type="int", min=0, max=1000000))
+    f.set_value(1, 5)
     before = f.options.bit_depth
+    assert before < 20  # lazy depth: declared range does not pre-inflate it
     f.set_value(0, 100000)
     assert f.options.bit_depth > before
     assert f.value(0) == (100000, True)
+    assert f.value(1) == (5, True)
+
+
+def test_field_int_declared_range_enforced():
+    """field.go:1082-1086 ErrBSIGroupValueTooLow/High — writes outside the
+    declared [min, max] are rejected, which makes the planner's
+    options.min/max shortcut paths sound."""
+    f = Field(None, "i", "f", FieldOptions(type="int", min=10, max=30))
+    with pytest.raises(ValueError, match="too low"):
+        f.set_value(0, 9)
+    with pytest.raises(ValueError, match="too high"):
+        f.set_value(0, 31)
+    with pytest.raises(ValueError, match="too high"):
+        f.import_values(np.array([1, 2]), np.array([15, 1000]))
+    f.set_value(0, 10)
+    f.set_value(1, 30)
+    assert f.value(0) == (10, True)
+    assert f.value(1) == (30, True)
+
+
+def test_fragment_row_id_cap():
+    """Hostile row ids must be rejected before the dense allocation
+    (ADVICE: rowIDs=[2**40] would attempt a terabyte-scale allocation)."""
+    frag = Fragment(None, "i", "f", "standard", 0)
+    with pytest.raises(ValueError, match="max_row_id"):
+        frag.set_bit(2 ** 40, 0)
+    with pytest.raises(ValueError, match="max_row_id"):
+        frag.bulk_import(np.array([1, 2 ** 40]), np.array([0, 1]))
+    assert frag.n_rows == 0  # nothing allocated
 
 
 def test_field_import_values():
