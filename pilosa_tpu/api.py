@@ -89,6 +89,12 @@ class API:
 
     # -- DDL ---------------------------------------------------------------
 
+    def _broadcast(self, msg: dict):
+        """Schema changes propagate to every node synchronously
+        (api.go:233 CreateField -> SendSync, broadcast.go:30)."""
+        if self.cluster is not None:
+            self.cluster.broadcast(msg)
+
     def create_index(self, name: str, keys: bool = False,
                      track_existence: bool = True):
         self._validate("CreateIndex")
@@ -99,6 +105,8 @@ class API:
             raise ConflictError(str(e))
         except ValueError as e:
             raise ApiError(str(e))
+        self._broadcast({"type": "create-index", "index": name,
+                         "keys": keys, "trackExistence": track_existence})
         return idx
 
     def delete_index(self, name: str):
@@ -107,6 +115,7 @@ class API:
             self.holder.delete_index(name)
         except ValueError as e:
             raise NotFoundError(str(e))
+        self._broadcast({"type": "delete-index", "index": name})
 
     def create_field(self, index: str, field: str,
                      options: dict | None = None):
@@ -116,11 +125,14 @@ class API:
             raise NotFoundError(f"index not found: {index}")
         opts = FieldOptions.from_dict(options or {})
         try:
-            return idx.create_field(field, opts)
+            f = idx.create_field(field, opts)
         except FileExistsError as e:
             raise ConflictError(str(e))
         except ValueError as e:
             raise ApiError(str(e))
+        self._broadcast({"type": "create-field", "index": index,
+                         "field": field, "options": options or {}})
+        return f
 
     def delete_field(self, index: str, field: str):
         self._validate("DeleteField")
@@ -131,6 +143,8 @@ class API:
             idx.delete_field(field)
         except ValueError as e:
             raise NotFoundError(str(e))
+        self._broadcast({"type": "delete-field", "index": index,
+                         "field": field})
 
     def schema(self) -> list[dict]:
         self._validate("Schema")
@@ -145,10 +159,17 @@ class API:
             idx = self.holder.create_index_if_not_exists(
                 name, keys=opts.get("keys", False),
                 track_existence=opts.get("trackExistence", True))
+            self._broadcast({"type": "create-index", "index": name,
+                             "keys": opts.get("keys", False),
+                             "trackExistence": opts.get("trackExistence",
+                                                        True)})
             for fdef in idx_def.get("fields", []):
                 idx.create_field_if_not_exists(
                     fdef["name"], FieldOptions.from_dict(
                         fdef.get("options", {})))
+                self._broadcast({"type": "create-field", "index": name,
+                                 "field": fdef["name"],
+                                 "options": fdef.get("options", {})})
 
     # -- import (api.go:920 Import / :1031 ImportValue / :368 ImportRoaring)
 
@@ -161,12 +182,24 @@ class API:
         cols = np.asarray(column_ids or [], dtype=np.int64)
         if rows.size != cols.size:
             raise ApiError("rowIDs and columnIDs length mismatch")
-        ts = None
         if timestamps and len(timestamps) != cols.size:
             raise ApiError("timestamps length mismatch")
+        if self.cluster is not None:
+            # regroup by shard, forward each batch to its owners
+            # (api.go:963-996)
+            self.cluster.import_bits(index, field, rows, cols, timestamps,
+                                     clear=clear)
+            return
+        self._import_bits_local(idx, f, rows, cols, timestamps, clear)
+
+    @staticmethod
+    def _import_bits_local(idx, f, rows, cols, timestamps, clear):
+        ts = None
         if timestamps:
-            from datetime import datetime
-            ts = [None if t in (None, 0) else datetime.utcfromtimestamp(t)
+            from datetime import datetime, timezone
+            ts = [None if t in (None, 0)
+                  else datetime.fromtimestamp(t, timezone.utc)
+                  .replace(tzinfo=None)
                   for t in timestamps]
         f.import_bits(rows, cols, ts, clear=clear)
         if not clear:
@@ -180,15 +213,46 @@ class API:
         vals = np.asarray(values or [], dtype=np.int64)
         if not clear and cols.size != vals.size:
             raise ApiError("columnIDs and values length mismatch")
+        if self.cluster is not None:
+            self.cluster.import_values(index, field, cols, vals, clear=clear)
+            return
         f.import_values(cols, vals, clear=clear)
         if not clear:
             idx.add_existence(cols)
+
+    def apply_import_local(self, index: str, field: str, payload: dict):
+        """Apply a forwarded (pre-grouped) import batch locally — the
+        receive side of the cluster import fan-out; never re-forwards."""
+        idx, f = self._index_field(index, field)
+        if "values" in payload and payload.get("values") is not None:
+            cols = np.asarray(payload.get("columnIDs") or [], dtype=np.int64)
+            vals = np.asarray(payload["values"], dtype=np.int64)
+            f.import_values(cols, vals, clear=payload.get("clear", False))
+            if not payload.get("clear", False):
+                idx.add_existence(cols)
+            return
+        rows = np.asarray(payload.get("rowIDs") or [], dtype=np.int64)
+        cols = np.asarray(payload.get("columnIDs") or [], dtype=np.int64)
+        if payload.get("clear", False) and "rowIDs" not in payload:
+            f.import_values(cols, np.zeros(0, dtype=np.int64), clear=True)
+            return
+        self._import_bits_local(idx, f, rows, cols,
+                                payload.get("timestamps"),
+                                payload.get("clear", False))
 
     def import_roaring(self, index: str, field: str, shard: int,
                        views: dict[str, bytes], clear: bool = False):
         """Import pre-serialized pilosa-roaring bitmaps, one per view
         (api.go:368 ImportRoaring)."""
         self._validate("ImportRoaring")
+        if self.cluster is not None:
+            self.cluster.import_roaring(index, field, shard, views, clear)
+            return
+        self.apply_import_roaring_local(index, field, shard, views, clear)
+
+    def apply_import_roaring_local(self, index: str, field: str, shard: int,
+                                   views: dict[str, bytes],
+                                   clear: bool = False):
         idx, f = self._index_field(index, field)
         from .storage.roaring_io import unpack_roaring
         all_cols = []

@@ -1,0 +1,846 @@
+"""Cluster: static-membership distribution layer (reference cluster.go,
+broadcast.go, http/client.go).
+
+The reference runs a gossip-managed elastic cluster (memberlist, resize
+jobs).  Per the TPU-native design (SURVEY §5.8) membership here is a
+*static node list from config* — the mesh analog of a fixed TPU topology —
+with a thin control plane over HTTP:
+
+* shard -> node placement: FNV-1a partition + jump hash ring with ReplicaN
+  successors (parallel/placement.py; cluster.go:871-959);
+* query fan-out: shards grouped by owner, local shards on the local
+  executor, remote groups POSTed as pinned single-call requests
+  (executor.go:2455 mapReduce, :2414 remoteExec), with replica retry when
+  a node is down (executor.go:2482-2514);
+* write fan-out: Set/Clear go to every replica of the target shard
+  (executor.go:2137-2166); Store/ClearRow to every node with its owned
+  shard list; attr writes broadcast (executor.go:2207-2412);
+* import regroup/forward: bits grouped by shard, each batch sent to every
+  owner (api.go:920-1028);
+* DDL broadcast: create/delete index/field POSTed to every peer
+  (broadcast.go:30 SendSync, server.go:569 receiveMessage);
+* failure detection: periodic /status probes; a node that fails a probe is
+  marked DOWN and the cluster goes DEGRADED (cluster.go:1724
+  confirmNodeDown; NORMAL<->DEGRADED cluster.go:571-583).
+
+Reductions between nodes happen host-side on small results (counts,
+ValCounts, pairs, compressed row segments); the heavy per-shard bitmap
+work stays on each node's devices (its mesh executor / XLA plans).
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+import threading
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any
+
+import numpy as np
+
+from ..core import SHARD_WIDTH, SHARD_WORDS
+from ..executor.results import (
+    GroupCount, FieldRow, Pair, RowIdentifiers, RowResult, ValCount,
+    merge_pairs, sort_pairs,
+)
+from ..pql import Call, Query, parse
+from ..pql.wire import call_from_wire, call_to_wire
+from .placement import Placement
+
+NODE_READY = "READY"
+NODE_DOWN = "DOWN"
+
+STATE_STARTING = "STARTING"
+STATE_NORMAL = "NORMAL"
+STATE_DEGRADED = "DEGRADED"
+
+
+class ClusterError(RuntimeError):
+    pass
+
+
+# -- result wire codec ------------------------------------------------------
+# (the reference's protobuf QueryResponse, encoding/proto/proto.go; JSON +
+# compressed raw segments here)
+
+def _seg_to_wire(seg) -> str:
+    words = np.asarray(seg, dtype=np.uint32)
+    return base64.b64encode(zlib.compress(words.tobytes(), 1)).decode()
+
+
+def _seg_from_wire(s: str) -> np.ndarray:
+    raw = zlib.decompress(base64.b64decode(s))
+    words = np.frombuffer(raw, dtype=np.uint32)
+    if words.size != SHARD_WORDS:
+        raise ClusterError(f"bad segment size {words.size}")
+    return words
+
+
+def result_to_wire(r) -> dict:
+    if isinstance(r, RowResult):
+        return {"t": "row", "segments": {
+            str(s): _seg_to_wire(seg) for s, seg in r.segments.items()}}
+    if isinstance(r, ValCount):
+        return {"t": "valcount", "val": r.val, "count": r.count}
+    if isinstance(r, RowIdentifiers):
+        return {"t": "rowids", "rows": r.rows, "keys": r.keys}
+    if isinstance(r, list) and (not r or isinstance(r[0], Pair)):
+        return {"t": "pairs",
+                "pairs": [[p.id, p.count, p.key] for p in r]}
+    if isinstance(r, list) and r and isinstance(r[0], GroupCount):
+        return {"t": "groups", "groups": [
+            {"group": [[fr.field, fr.row_id, fr.row_key] for fr in g.group],
+             "count": g.count} for g in r]}
+    return {"t": "raw", "v": r}
+
+
+def result_from_wire(d: dict):
+    t = d.get("t")
+    if t == "row":
+        return RowResult({int(s): _seg_from_wire(w)
+                          for s, w in d["segments"].items()})
+    if t == "valcount":
+        return ValCount(d["val"], d["count"])
+    if t == "rowids":
+        return RowIdentifiers(rows=d["rows"], keys=d.get("keys") or [])
+    if t == "pairs":
+        return [Pair(i, c, k) for i, c, k in d["pairs"]]
+    if t == "groups":
+        return [GroupCount([FieldRow(f, ri, rk) for f, ri, rk in g["group"]],
+                           g["count"]) for g in d["groups"]]
+    return d.get("v")
+
+
+# -- internal RPC client ----------------------------------------------------
+
+class InternalClient:
+    """Node-to-node HTTP RPC (reference http/client.go:69 InternalClient)."""
+
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    def _request(self, host: str, method: str, path: str,
+                 body: bytes | None = None,
+                 ctype: str = "application/json") -> tuple[int, bytes]:
+        h, _, p = host.rpartition(":")
+        conn = http.client.HTTPConnection(h or "localhost", int(p),
+                                          timeout=self.timeout)
+        try:
+            headers = {"Content-Type": ctype,
+                       "Content-Length": str(len(body or b""))}
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def _json(self, host, method, path, obj=None):
+        body = None if obj is None else json.dumps(obj).encode()
+        status, data = self._request(host, method, path, body)
+        if status >= 400:
+            try:
+                msg = json.loads(data).get("error", data.decode())
+            except Exception:
+                msg = data.decode(errors="replace")
+            raise ClusterError(f"{host} {path}: {status} {msg}")
+        return json.loads(data) if data else {}
+
+    # -- RPCs --------------------------------------------------------------
+
+    def status(self, host: str) -> dict:
+        return self._json(host, "GET", "/status")
+
+    def query_call(self, host: str, index: str, call: Call,
+                   shards: list[int] | None) -> Any:
+        """(http/client.go:268 QueryNode — pinned single-call query)"""
+        out = self._json(host, "POST", f"/internal/query/{index}", {
+            "call": call_to_wire(call),
+            "shards": shards,
+        })
+        return result_from_wire(out["result"])
+
+    def send_message(self, host: str, msg: dict):
+        """(broadcast.go SendTo -> POST /internal/cluster/message)"""
+        self._json(host, "POST", "/internal/cluster/message", msg)
+
+    def import_local(self, host: str, index: str, field: str, payload: dict):
+        """Forward a pre-grouped import batch to a shard owner
+        (http/client.go Import; applied locally, never re-forwarded)."""
+        self._json(host, "POST",
+                   f"/internal/import/{index}/{field}", payload)
+
+    def available_shards(self, host: str, index: str) -> list[int]:
+        out = self._json(host, "GET", f"/internal/index/{index}/shards")
+        return out.get("shards", [])
+
+    def fragment_blocks(self, host: str, index: str, field: str, view: str,
+                        shard: int) -> dict[int, str]:
+        out = self._json(
+            host, "GET",
+            f"/internal/fragment/blocks?index={index}&field={field}"
+            f"&view={view}&shard={shard}")
+        return {int(k): v for k, v in out.get("blocks", {}).items()}
+
+    def block_data(self, host: str, index: str, field: str, view: str,
+                   shard: int, block: int) -> tuple[np.ndarray, np.ndarray]:
+        out = self._json(
+            host, "GET",
+            f"/internal/fragment/block/data?index={index}&field={field}"
+            f"&view={view}&shard={shard}&block={block}")
+        return (np.asarray(out["rows"], dtype=np.int64),
+                np.asarray(out["cols"], dtype=np.int64))
+
+    def fragment_data(self, host: str, index: str, field: str, view: str,
+                      shard: int) -> bytes:
+        """Whole-fragment fetch as a pilosa-roaring blob
+        (http/client.go:742 RetrieveShardFromURI)."""
+        status, data = self._request(
+            host, "GET",
+            f"/internal/fragment/data?index={index}&field={field}"
+            f"&view={view}&shard={shard}")
+        if status >= 400:
+            raise ClusterError(f"fragment data fetch failed: {status}")
+        return data
+
+
+# -- node & cluster ---------------------------------------------------------
+
+class Node:
+    def __init__(self, node_id: str, host: str):
+        self.id = node_id
+        self.host = host
+        self.state = NODE_READY
+
+    def to_dict(self, coordinator_id: str) -> dict:
+        return {"id": self.id, "uri": self.host,
+                "isCoordinator": self.id == coordinator_id,
+                "state": self.state}
+
+
+class Cluster:
+    """Static-membership cluster (the module server.py:103 wires in).
+
+    ``hosts`` is the ordered node list from config; node ids are
+    "node0".."nodeN-1" by position and ``node_id`` selects which entry is
+    this process (matching the reference's URI-identity with explicit
+    names).  Node 0 is the coordinator (primary for DDL broadcast).
+    """
+
+    def __init__(self, node_id: str, hosts: list[str], replica_n: int = 1,
+                 holder=None, hasher=None, health_interval: float = 5.0):
+        self.nodes = [Node(f"node{i}", h) for i, h in enumerate(hosts)]
+        self.by_id = {n.id: n for n in self.nodes}
+        if node_id not in self.by_id:
+            raise ClusterError(
+                f"node_id {node_id!r} not in cluster hosts (expected one of "
+                f"{sorted(self.by_id)})")
+        self.node_id = node_id
+        self.holder = holder
+        self.replica_n = replica_n
+        self.placement = Placement([n.id for n in self.nodes],
+                                   replica_n=replica_n, hasher=hasher)
+        self.client = InternalClient()
+        self.api = None
+        self.state = STATE_STARTING
+        self.health_interval = health_interval
+        self._closing = threading.Event()
+        self._health_thread = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(4, 2 * len(self.nodes)))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def open(self, api):
+        self.api = api
+        self.state = STATE_NORMAL
+        if self.health_interval > 0:
+            self._health_thread = threading.Thread(
+                target=self._monitor_health, daemon=True)
+            self._health_thread.start()
+
+    def close(self):
+        self._closing.set()
+        self._pool.shutdown(wait=False)
+
+    @property
+    def local(self) -> Node:
+        return self.by_id[self.node_id]
+
+    def peers(self) -> list[Node]:
+        return [n for n in self.nodes if n.id != self.node_id]
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.node_id == self.nodes[0].id
+
+    # -- failure detection (cluster.go:1724 confirmNodeDown) ---------------
+
+    def _monitor_health(self):
+        while not self._closing.wait(self.health_interval):
+            self.probe_peers()
+
+    def probe_peers(self):
+        for n in self.peers():
+            was_down = n.state == NODE_DOWN
+            try:
+                self.client.status(n.host)
+                n.state = NODE_READY
+            except Exception:
+                n.state = NODE_DOWN
+                continue
+            if was_down:
+                # Schema catch-up: a node that was DOWN during a DDL
+                # broadcast missed it permanently (broadcast skips DOWN
+                # peers), so on recovery push the full schema (the
+                # reference replays state via ClusterStatus on rejoin,
+                # cluster.go:1301 mergeClusterStatus/applySchema).
+                try:
+                    self.client.send_message(n.host, {
+                        "type": "apply-schema",
+                        "schema": self.holder.schema(),
+                    })
+                except Exception:
+                    n.state = NODE_DOWN
+        self._update_state()
+
+    def _update_state(self):
+        if self.state == STATE_STARTING:
+            return
+        down = any(n.state == NODE_DOWN for n in self.nodes)
+        self.state = STATE_DEGRADED if down else STATE_NORMAL
+
+    def _mark_down(self, node_id: str):
+        n = self.by_id.get(node_id)
+        if n is not None:
+            n.state = NODE_DOWN
+            self._update_state()
+
+    # -- info --------------------------------------------------------------
+
+    def node_statuses(self) -> list[dict]:
+        coord = self.nodes[0].id
+        return [n.to_dict(coord) for n in self.nodes]
+
+    def shard_nodes_info(self, index: str, shard: int) -> list[dict]:
+        return [{"id": nid, "uri": self.by_id[nid].host}
+                for nid in self.placement.shard_nodes(index, shard)]
+
+    # -- shard discovery ---------------------------------------------------
+
+    def _available_shards(self, index: str) -> list[int]:
+        """Union of local + peer available shards.  The reference gossips
+        per-field available-shard bitmaps (field.go:263); with static
+        membership we ask peers directly and fold the answer into
+        remote-known shards so it converges without re-asking."""
+        idx = self.holder.index(index)
+        shards = set(idx.available_shards()) if idx is not None else set()
+        for n in self.peers():
+            if n.state != NODE_READY:
+                continue
+            try:
+                shards.update(self.client.available_shards(n.host, index))
+            except Exception:
+                self._mark_down(n.id)
+        return sorted(shards)
+
+    # -- query fan-out (executor.go:2455 mapReduce) ------------------------
+
+    def execute(self, index: str, query, shards=None) -> list[Any]:
+        if isinstance(query, str):
+            query = parse(query)
+        if self.holder.index(index) is None:
+            from ..api import NotFoundError
+            raise NotFoundError(f"index not found: {index}")
+        if shards is None:
+            shards = self._available_shards(index)
+        return [self._execute_call(index, c, shards) for c in query.calls]
+
+    def _execute_call(self, index: str, c: Call, shards: list[int]):
+        if c.name in ("Set", "Clear"):
+            return self._execute_col_write(index, c)
+        if c.name in ("Store", "ClearRow"):
+            return self._execute_all_nodes_write(index, c, shards)
+        if c.name in ("SetRowAttrs", "SetColumnAttrs"):
+            return self._execute_attr_write(index, c)
+        if c.name == "Options" and "shards" in c.args:
+            pinned = [int(s) for s in c.args["shards"]]
+            return self._execute_call(index, c.children[0], pinned)
+        return self._execute_read(index, c, shards)
+
+    def _local_exec(self, index: str, c: Call, shards: list[int]):
+        return self.api.executor.execute(index, Query([c]), shards)[0]
+
+    def _ready_owner_order(self, index: str, shard: int) -> list[str]:
+        owners = self.placement.shard_nodes(index, shard)
+        ready = [o for o in owners if self.by_id[o].state == NODE_READY]
+        return ready or owners
+
+    def _group_shards(self, index: str,
+                      shards: list[int],
+                      exclude: set[str] = frozenset()) -> dict[str, list]:
+        """shard -> preferred executor node: self if it owns the shard,
+        else the first READY owner (executor.go:2435 shardsByNode)."""
+        groups: dict[str, list[int]] = {}
+        for s in shards:
+            order = [o for o in self._ready_owner_order(index, s)
+                     if o not in exclude]
+            if not order:
+                raise ClusterError(
+                    f"no available node for shard {s} of {index!r}")
+            target = self.node_id if self.node_id in order else order[0]
+            groups.setdefault(target, []).append(s)
+        return groups
+
+    def _execute_read(self, index: str, c: Call, shards: list[int]):
+        send = c
+        if c.name == "TopN" and "n" in c.args:
+            # A node's local top-n would truncate rows whose global count
+            # only wins across nodes; the reference re-fetches exact counts
+            # in a second phase (executor.go:879-899).  Per-node counts
+            # here are exact already, so fan out WITHOUT the limit and
+            # apply n at reduce time.
+            send = c.clone()
+            del send.args["n"]
+        results: list[Any] = []
+        exclude: set[str] = set()
+        pending = list(shards)
+        if not pending:
+            return self._reduce(c, [self._local_exec(index, send, [])])
+        for _attempt in range(len(self.nodes) + 1):
+            if not pending and results:
+                break
+            groups = self._group_shards(index, pending, exclude)
+            futures = {}
+            # submit remote work BEFORE running the local group so the two
+            # overlap (the reference's mapperLocal + remoteExec run
+            # concurrently, executor.go:2455)
+            local_shards = groups.pop(self.node_id, None)
+            for nid, nshards in groups.items():
+                futures[nid] = (nshards, self._pool.submit(
+                    self.client.query_call, self.by_id[nid].host, index,
+                    send, nshards))
+            if local_shards is not None:
+                results.append(self._local_exec(index, send, local_shards))
+            pending = []
+            for nid, (nshards, fut) in futures.items():
+                try:
+                    results.append(fut.result())
+                except Exception:
+                    # replica retry (executor.go:2482 reduce with node
+                    # failure -> retry against remaining replicas)
+                    self._mark_down(nid)
+                    exclude.add(nid)
+                    pending.extend(nshards)
+            if not pending:
+                break
+        else:
+            raise ClusterError("query retries exhausted")
+        if pending:
+            raise ClusterError(
+                f"no replicas available for shards {pending} of {index!r}")
+        return self._reduce(c, results)
+
+    # -- writes ------------------------------------------------------------
+
+    def _require_ready(self, node_ids, what: str):
+        """Writes need every replica reachable: silently skipping a DOWN
+        owner would lose the write on that replica (and union-only
+        anti-entropy could later resurrect cleared bits from it).  The
+        reference likewise surfaces replica-write failures
+        (executor.go:2156-2166 remoteExec error propagation)."""
+        down = [nid for nid in node_ids
+                if nid != self.node_id
+                and self.by_id[nid].state != NODE_READY]
+        if down:
+            raise ClusterError(
+                f"cannot {what}: replica node(s) {down} unavailable")
+
+    def _execute_col_write(self, index: str, c: Call):
+        """Set/Clear: fan to every replica of the column's shard
+        (executor.go:2137-2166)."""
+        col = c.args.get("_col")
+        if not isinstance(col, int) or isinstance(col, bool):
+            return self._local_exec(index, c, [])
+        shard = col // SHARD_WIDTH
+        owners = self.placement.shard_nodes(index, shard)
+        self._require_ready(owners, f"write shard {shard} of {index!r}")
+        futures = []
+        for nid in owners:
+            if nid != self.node_id:
+                futures.append(self._pool.submit(
+                    self.client.query_call, self.by_id[nid].host, index, c,
+                    [shard]))
+        result = self._local_exec(index, c, [shard]) \
+            if self.node_id in owners else None
+        remote = None
+        for f in futures:
+            remote = f.result()  # raise on replica-write failure
+        return result if result is not None else remote
+
+    def _execute_all_nodes_write(self, index: str, c: Call,
+                                 shards: list[int]):
+        """Store/ClearRow touch every owned fragment on every node."""
+        involved = [n.id for n in self.nodes
+                    if self.placement.owned_shards(n.id, index, shards)]
+        self._require_ready(involved, f"{c.name} on {index!r}")
+        changed = False
+        futures = []
+        for n in self.nodes:
+            owned = self.placement.owned_shards(n.id, index, shards)
+            if not owned or n.id == self.node_id:
+                continue
+            futures.append(self._pool.submit(
+                self.client.query_call, n.host, index, c, owned))
+        local_owned = self.placement.owned_shards(self.node_id, index,
+                                                  shards)
+        if local_owned:
+            changed = bool(self._local_exec(index, c, local_owned))
+        for f in futures:
+            changed = bool(f.result()) or changed
+        return changed
+
+    def _execute_attr_write(self, index: str, c: Call):
+        """Attr stores are replicated on every node (executor.go:2207
+        SetRowAttrs local write + broadcast)."""
+        out = self._local_exec(index, c, [])
+        for n in self.peers():
+            if n.state == NODE_READY:
+                self.client.query_call(n.host, index, c, [])
+        return out
+
+    # -- reduce (executor.go:2482 reduce fns per call type) ----------------
+
+    def _reduce(self, c: Call, results: list[Any]):
+        results = [r for r in results if r is not None]
+        if not results:
+            return None
+        name = c.name
+        first = results[0]
+        if name == "Count":
+            return sum(int(r) for r in results)
+        if isinstance(first, RowResult):
+            segments = {}
+            for r in results:
+                segments.update(r.segments)
+            return RowResult(segments)
+        if isinstance(first, ValCount):
+            acc = first
+            for r in results[1:]:
+                if name == "Sum":
+                    acc = acc.add(r)
+                elif name in ("Min", "MinRow"):
+                    acc = acc.smaller(r)
+                else:
+                    acc = acc.larger(r)
+            return acc
+        if name == "TopN":
+            n, _ = c.uint_arg("n")
+            pairs = merge_pairs(results)
+            return sort_pairs([p for p in pairs if p.count > 0], n or None)
+        if isinstance(first, RowIdentifiers):
+            rows = sorted(set().union(*[set(r.rows) for r in results]))
+            limit = c.args.get("limit")
+            if limit is not None:
+                rows = rows[:limit]
+            return RowIdentifiers(rows=rows)
+        if name == "GroupBy":
+            return self._reduce_group_by(c, results)
+        return first
+
+    @staticmethod
+    def _reduce_group_by(c: Call, results: list[list[GroupCount]]):
+        """(executor.go:1195 mergeGroupCounts)"""
+        acc: dict[tuple, GroupCount] = {}
+        for node_groups in results:
+            for g in node_groups:
+                key = tuple((fr.field, fr.row_id) for fr in g.group)
+                if key in acc:
+                    acc[key] = GroupCount(g.group, acc[key].count + g.count)
+                else:
+                    acc[key] = g
+        out = sorted(acc.values(), key=lambda g: tuple(
+            (fr.field, fr.row_id) for fr in g.group))
+        limit = c.args.get("limit")
+        return out[:limit] if limit is not None else out
+
+    # -- DDL broadcast (broadcast.go:30, server.go:569 receiveMessage) -----
+
+    def broadcast(self, msg: dict):
+        """Send a cluster message to every READY peer, synchronously."""
+        errors = []
+        for n in self.peers():
+            if n.state != NODE_READY:
+                continue
+            try:
+                self.client.send_message(n.host, msg)
+            except Exception as e:
+                errors.append(f"{n.id}: {e}")
+        if errors:
+            raise ClusterError("broadcast failed: " + "; ".join(errors))
+
+    def handle_message(self, msg: dict):
+        """Apply a received cluster message locally (server.go:569)."""
+        t = msg.get("type")
+        holder = self.holder
+        if t == "create-index":
+            holder.create_index_if_not_exists(
+                msg["index"], keys=msg.get("keys", False),
+                track_existence=msg.get("trackExistence", True))
+        elif t == "delete-index":
+            try:
+                holder.delete_index(msg["index"])
+            except ValueError:
+                pass
+        elif t == "create-field":
+            from ..storage import FieldOptions
+            idx = holder.index(msg["index"])
+            if idx is None:
+                # can happen if this node missed the create-index while
+                # down; the field implies the index
+                idx = holder.create_index_if_not_exists(msg["index"])
+            idx.create_field_if_not_exists(
+                msg["field"], FieldOptions.from_dict(
+                    msg.get("options", {})))
+        elif t == "apply-schema":
+            from ..storage import FieldOptions
+            for idx_def in msg.get("schema", []):
+                opts = idx_def.get("options", {})
+                idx = holder.create_index_if_not_exists(
+                    idx_def["name"], keys=opts.get("keys", False),
+                    track_existence=opts.get("trackExistence", True))
+                for fdef in idx_def.get("fields", []):
+                    idx.create_field_if_not_exists(
+                        fdef["name"],
+                        FieldOptions.from_dict(fdef.get("options", {})))
+        elif t == "delete-field":
+            idx = holder.index(msg["index"])
+            if idx is not None:
+                try:
+                    idx.delete_field(msg["field"])
+                except ValueError:
+                    pass
+        else:
+            raise ClusterError(f"unknown cluster message type {t!r}")
+
+    # -- import forwarding (api.go:920-1028) -------------------------------
+
+    def _forward_grouped(self, index: str, field: str, cols: np.ndarray,
+                         payload_fn):
+        """Shared import fan-out: group bits by shard, build one payload
+        per owner node via ``payload_fn(selection_mask)``, apply locally /
+        POST remotely in parallel (api.go:963-996 importsByNode)."""
+        shards = cols // SHARD_WIDTH
+        by_node: dict[str, list[int]] = {}
+        for s in np.unique(shards):
+            owners = self.placement.shard_nodes(index, int(s))
+            self._require_ready(owners, f"import shard {int(s)}")
+            for nid in owners:
+                by_node.setdefault(nid, []).append(int(s))
+        idx = self.holder.index(index)
+        futures = []
+        local_payload = None
+        for nid, nshards in by_node.items():
+            payload = payload_fn(np.isin(shards, nshards))
+            if nid == self.node_id:
+                local_payload = payload
+                continue
+            futures.append(self._pool.submit(
+                self.client.import_local, self.by_id[nid].host, index,
+                field, payload))
+            if idx is not None:
+                f = idx.field(field)
+                if f is not None:
+                    f.remote_available_shards.update(
+                        s for s in nshards
+                        if not self.placement.owns_shard(
+                            self.node_id, index, s))
+        if local_payload is not None:
+            self.api.apply_import_local(index, field, local_payload)
+        for fut in futures:
+            fut.result()  # propagate owner-import failures
+
+    def import_bits(self, index: str, field: str, rows: np.ndarray,
+                    cols: np.ndarray, timestamps=None, clear: bool = False):
+        """Group bits by shard, send each shard batch to every owner."""
+        self._forward_grouped(index, field, cols, lambda sel: {
+            "rowIDs": rows[sel].tolist(),
+            "columnIDs": cols[sel].tolist(),
+            "timestamps": ([timestamps[i] for i in np.nonzero(sel)[0]]
+                           if timestamps else None),
+            "clear": clear,
+        })
+
+    def import_values(self, index: str, field: str, cols: np.ndarray,
+                      vals: np.ndarray, clear: bool = False):
+        self._forward_grouped(index, field, cols, lambda sel: {
+            "columnIDs": cols[sel].tolist(),
+            "values": vals[sel].tolist() if not clear else None,
+            "clear": clear,
+        })
+
+    def import_roaring(self, index: str, field: str, shard: int,
+                       views: dict[str, bytes], clear: bool):
+        """Forward a pre-serialized roaring import to each shard owner."""
+        for nid in self.placement.shard_nodes(index, shard):
+            if nid == self.node_id:
+                self.api.apply_import_roaring_local(index, field, shard,
+                                                    views, clear)
+            else:
+                payload = {
+                    "shard": shard,
+                    "clear": clear,
+                    "views": {k: base64.b64encode(v).decode()
+                              for k, v in views.items()},
+                }
+                self.client.import_local(self.by_id[nid].host, index, field,
+                                         payload)
+
+    # -- anti-entropy (holder.go:909 holderSyncer; fleshed out with the
+    # block-merge protocol in storage/fragment blocks/block_data) ----------
+
+    def sync_holder(self):
+        """Minimal anti-entropy pass: for every owned fragment, compare
+        block checksums with replicas and pull whole fragments we lack
+        (fragment.go:2876 full-copy path).  Block-level merge arrives with
+        the fragment streaming endpoints."""
+        from ..storage.roaring_io import unpack_roaring
+
+        holder = self.holder
+        for index_name, idx in list(holder.indexes.items()):
+            shards = self._available_shards(index_name)
+            for fname, f in list(idx.fields.items()):
+                for s in shards:
+                    owners = self.placement.shard_nodes(index_name, s)
+                    if self.node_id not in owners:
+                        continue
+                    for vname in list(f.views) or ["standard"]:
+                        self._sync_fragment(index_name, fname, vname, s,
+                                            owners, unpack_roaring)
+
+    def _sync_fragment(self, index: str, field: str, view: str, shard: int,
+                       owners: list[str], unpack_roaring):
+        local = self.holder.fragment(index, field, view, shard)
+        # hex digests to match the wire encoding of fragment_blocks
+        local_blocks = {b: ck.hex() for b, ck in local.blocks().items()} \
+            if local is not None else {}
+        for nid in owners:
+            if nid == self.node_id or self.by_id[nid].state != NODE_READY:
+                continue
+            host = self.by_id[nid].host
+            try:
+                remote_blocks = self.client.fragment_blocks(
+                    host, index, field, view, shard)
+            except Exception:
+                continue
+            diff = [b for b, ck in remote_blocks.items()
+                    if local_blocks.get(b) != ck]
+            if not diff:
+                continue
+            if not local_blocks:
+                # local empty -> whole-fragment copy (fragment.go:2876)
+                try:
+                    blob = self.client.fragment_data(
+                        host, index, field, view, shard)
+                except Exception:
+                    continue
+                rows, cols = unpack_roaring(blob)
+                idx = self.holder.index(index)
+                frag = idx.field(field)._create_view_if_not_exists(view) \
+                    .create_fragment_if_not_exists(shard)
+                frag.bulk_import(rows, cols)
+                continue
+            for b in diff:
+                try:
+                    rows, cols = self.client.block_data(
+                        host, index, field, view, shard, b)
+                except Exception:
+                    continue
+                idx = self.holder.index(index)
+                frag = idx.field(field)._create_view_if_not_exists(view) \
+                    .create_fragment_if_not_exists(shard)
+                # union merge: add remote bits we lack (the union-majority
+                # refinement lands with mergeBlock parity)
+                frag.bulk_import(rows, cols)
+
+    # -- internal HTTP routes (handler.go:302-314 /internal/*) -------------
+
+    def register_routes(self, router):
+        cluster = self
+
+        def internal_query(req, args):
+            body = req.json()
+            call = call_from_wire(body["call"])
+            shards = body.get("shards")
+            result = cluster._local_exec(args["index"], call, shards or [])
+            return {"result": result_to_wire(result)}
+
+        router.add("POST", "/internal/query/{index}", internal_query)
+
+        def cluster_message(req, args):
+            cluster.handle_message(req.json())
+            return {}
+
+        router.add("POST", "/internal/cluster/message", cluster_message)
+
+        def internal_import(req, args):
+            body = req.json()
+            if "views" in body:
+                views = {k: base64.b64decode(v)
+                         for k, v in body["views"].items()}
+                cluster.api.apply_import_roaring_local(
+                    args["index"], args["field"], int(body["shard"]),
+                    views, body.get("clear", False))
+            else:
+                cluster.api.apply_import_local(args["index"], args["field"],
+                                               body)
+            return {}
+
+        router.add("POST", "/internal/import/{index}/{field}",
+                   internal_import)
+
+        def index_shards(req, args):
+            idx = cluster.holder.index(args["index"])
+            shards = sorted(idx.available_shards()) if idx else []
+            return {"shards": shards}
+
+        router.add("GET", "/internal/index/{index}/shards", index_shards)
+
+        def _frag(req):
+            index = req.query.get("index", [""])[0]
+            field = req.query.get("field", [""])[0]
+            view = req.query.get("view", ["standard"])[0]
+            shard = int(req.query.get("shard", ["0"])[0])
+            return cluster.holder.fragment(index, field, view, shard)
+
+        def fragment_blocks(req, args):
+            frag = _frag(req)
+            if frag is None:
+                return {"blocks": {}}
+            return {"blocks": {str(b): ck.hex()
+                               for b, ck in frag.blocks().items()}}
+
+        router.add("GET", "/internal/fragment/blocks", fragment_blocks)
+
+        def block_data(req, args):
+            frag = _frag(req)
+            block = int(req.query.get("block", ["0"])[0])
+            if frag is None:
+                return {"rows": [], "cols": []}
+            rows, cols = frag.block_data(block)
+            return {"rows": rows.tolist(), "cols": cols.tolist()}
+
+        router.add("GET", "/internal/fragment/block/data", block_data)
+
+        def fragment_data(req, args):
+            from ..storage.roaring_io import pack_roaring
+            from ..ops import bitset
+            frag = _frag(req)
+            if frag is None:
+                rows = cols = np.zeros(0, dtype=np.int64)
+            else:
+                rows, cols = bitset.unpack_fragment(frag.words)
+            return ("application/octet-stream", pack_roaring(rows, cols))
+
+        router.add("GET", "/internal/fragment/data", fragment_data)

@@ -1,0 +1,292 @@
+"""Multi-node cluster tests via the in-process harness (reference
+test/pilosa.go:243-330 test.Cluster — N real servers in one process wired
+through real HTTP on localhost ephemeral ports).
+
+Covers: DDL broadcast, shard-grouped query fan-out with reduce
+(Intersect/Count/TopN/Sum/GroupBy/Rows), replica write fan-out, import
+regroup/forward, node-down degradation with replica retry, and a basic
+anti-entropy repair pass."""
+
+import json
+import socket
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import SHARD_WIDTH
+from pilosa_tpu.server.server import Config, Server
+
+
+def _free_ports(n: int) -> list[int]:
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def make_cluster(tmp_path, n=3, replica_n=2):
+    ports = _free_ports(n)
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = []
+    for i, p in enumerate(ports):
+        cfg = Config(
+            data_dir=str(tmp_path / f"node{i}"),
+            bind=f"localhost:{p}",
+            node_id=f"node{i}",
+            cluster_hosts=hosts,
+            replica_n=replica_n,
+            anti_entropy_interval=0,  # driven manually in tests
+        )
+        srv = Server(cfg)
+        srv.open()
+        servers.append(srv)
+    return servers
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    servers = make_cluster(tmp_path, n=3, replica_n=2)
+    yield servers
+    for s in servers:
+        try:
+            s.close()
+        except Exception:
+            pass
+
+
+def _req(port, method, path, data=None):
+    body = None
+    if data is not None:
+        body = data.encode() if isinstance(data, str) else json.dumps(
+            data).encode()
+    r = urllib.request.Request(
+        f"http://localhost:{port}{path}", method=method, data=body)
+    with urllib.request.urlopen(r) as resp:
+        return json.loads(resp.read())
+
+
+def query(port, index, pql):
+    return _req(port, "POST", f"/index/{index}/query", pql)["results"]
+
+
+def setup_index(servers, name="ci"):
+    p = servers[0].port
+    _req(p, "POST", f"/index/{name}", {})
+    _req(p, "POST", f"/index/{name}/field/f", {})
+    _req(p, "POST", f"/index/{name}/field/v",
+         {"options": {"type": "int", "min": 0, "max": 1000}})
+    return name
+
+
+def test_ddl_broadcast(cluster3):
+    setup_index(cluster3)
+    # schema visible on every node without any query traffic
+    for srv in cluster3:
+        schema = _req(srv.port, "GET", "/schema")["indexes"]
+        assert [i["name"] for i in schema] == ["ci"]
+        fields = {f["name"] for f in schema[0]["fields"]}
+        assert {"f", "v"} <= fields
+
+
+def test_status_reports_nodes(cluster3):
+    st = _req(cluster3[0].port, "GET", "/status")
+    assert st["state"] == "NORMAL"
+    assert len(st["nodes"]) == 3
+    assert st["nodes"][0]["isCoordinator"]
+
+
+def test_import_and_distributed_queries(cluster3):
+    setup_index(cluster3)
+    rng = np.random.default_rng(7)
+    n_shards = 6
+    cols = rng.choice(n_shards * SHARD_WIDTH, size=3000, replace=False)
+    rows = rng.integers(0, 8, size=3000)
+    vals = rng.integers(0, 1000, size=1500)
+
+    p0 = cluster3[0].port
+    _req(p0, "POST", "/index/ci/field/f/import",
+         {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()})
+    _req(p0, "POST", "/index/ci/field/v/import",
+         {"columnIDs": cols[:1500].tolist(), "values": vals.tolist()})
+
+    # oracle
+    by_row = {r: set(cols[rows == r].tolist()) for r in range(8)}
+
+    # every node answers identically (fan-out + reduce from any node)
+    for srv in cluster3:
+        [count] = query(srv.port, "ci", "Count(Row(f=3))")
+        assert count == len(by_row[3])
+
+    [cols_out] = query(cluster3[1].port, "ci", "Row(f=1)")
+    assert set(cols_out["columns"]) == by_row[1]
+
+    [inter] = query(cluster3[2].port, "ci",
+                    "Count(Intersect(Row(f=1), Row(f=2)))")
+    assert inter == len(by_row[1] & by_row[2])
+
+    [topn] = query(cluster3[0].port, "ci", "TopN(f, n=3)")
+    exact = sorted(((len(v), -r) for r, v in by_row.items()), reverse=True)
+    assert [(p["count"]) for p in topn] == [c for c, _ in exact[:3]]
+
+    [s] = query(cluster3[1].port, "ci", "Sum(field=v)")
+    assert s["value"] == int(vals.sum())
+
+    [rws] = query(cluster3[2].port, "ci", "Rows(f)")
+    assert rws["rows"] == sorted(by_row)
+
+
+def test_replica_write_fanout(cluster3):
+    setup_index(cluster3)
+    # write through a NON-owner node: must reach all replicas of the shard
+    col = 3 * SHARD_WIDTH + 17
+    [changed] = query(cluster3[1].port, "ci", f"Set({col}, f=5)")
+    assert changed is True
+
+    cl = cluster3[0].cluster
+    owners = cl.placement.shard_nodes("ci", 3)
+    assert len(owners) == 2
+    for srv in cluster3:
+        nid = srv.cluster.node_id
+        frag = srv.holder.fragment("ci", "f", "standard", 3)
+        if nid in owners:
+            assert frag is not None, f"{nid} owns shard 3 but has no data"
+            assert col % SHARD_WIDTH in frag.row_columns(5)
+        else:
+            assert frag is None or col % SHARD_WIDTH not in \
+                frag.row_columns(5)
+
+    # every node sees the bit through queries regardless of placement
+    for srv in cluster3:
+        [cnt] = query(srv.port, "ci", "Count(Row(f=5))")
+        assert cnt == 1
+
+
+def test_store_and_clearrow_cluster_wide(cluster3):
+    setup_index(cluster3)
+    cols = [10, SHARD_WIDTH + 5, 4 * SHARD_WIDTH + 2]
+    for c in cols:
+        query(cluster3[0].port, "ci", f"Set({c}, f=1)")
+    [ok] = query(cluster3[1].port, "ci", "Store(Row(f=1), f=9)")
+    assert ok is True
+    [out] = query(cluster3[2].port, "ci", "Row(f=9)")
+    assert set(out["columns"]) == set(cols)
+    [ok] = query(cluster3[0].port, "ci", "ClearRow(f=9)")
+    assert ok is True
+    [cnt] = query(cluster3[1].port, "ci", "Count(Row(f=9))")
+    assert cnt == 0
+
+
+def test_node_down_replica_retry(cluster3):
+    setup_index(cluster3)
+    rng = np.random.default_rng(11)
+    cols = rng.choice(4 * SHARD_WIDTH, size=1000, replace=False)
+    rows = rng.integers(0, 4, size=1000)
+    _req(cluster3[0].port, "POST", "/index/ci/field/f/import",
+         {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()})
+    expect = int((rows == 2).sum())
+
+    [cnt] = query(cluster3[0].port, "ci", "Count(Row(f=2))")
+    assert cnt == expect
+
+    # kill node2; with ReplicaN=2 every shard still has a live owner
+    cluster3[2].close()
+    cluster3[0].cluster.probe_peers()
+    assert cluster3[0].cluster.state == "DEGRADED"
+
+    [cnt] = query(cluster3[0].port, "ci", "Count(Row(f=2))")
+    assert cnt == expect
+    [topn] = query(cluster3[0].port, "ci", "TopN(f, n=2)")
+    assert len(topn) == 2
+
+
+def test_group_by_across_nodes(cluster3):
+    setup_index(cluster3)
+    _req(cluster3[0].port, "POST", "/index/ci/field/g", {})
+    cols = [1, 2, SHARD_WIDTH + 1, 2 * SHARD_WIDTH + 3]
+    for c in cols:
+        query(cluster3[0].port, "ci", f"Set({c}, f=1)")
+        query(cluster3[0].port, "ci", f"Set({c}, g={c % 2})")
+    [groups] = query(cluster3[1].port, "ci", "GroupBy(Rows(f), Rows(g))")
+    got = {(tuple((fr["field"], fr["rowID"]) for fr in g["group"])):
+           g["count"] for g in groups}
+    odd = sum(1 for c in cols if c % 2 == 1)
+    even = len(cols) - odd
+    assert got[(("f", 1), ("g", 0))] == even
+    assert got[(("f", 1), ("g", 1))] == odd
+
+
+def test_anti_entropy_repair(cluster3):
+    setup_index(cluster3)
+    col = 2 * SHARD_WIDTH + 9
+    query(cluster3[0].port, "ci", f"Set({col}, f=4)")
+    cl0 = cluster3[0].cluster
+    owners = cl0.placement.shard_nodes("ci", 2)
+    # wipe the fragment on one owner
+    victim = next(s for s in cluster3 if s.cluster.node_id == owners[1])
+    idx = victim.holder.index("ci")
+    f = idx.field("f")
+    v = f.view("standard")
+    assert v is not None and v.fragment(2) is not None
+    del v.fragments[2]
+    # run anti-entropy on the victim: it must pull the fragment back
+    victim.cluster.sync_holder()
+    frag = victim.holder.fragment("ci", "f", "standard", 2)
+    assert frag is not None
+    assert col % SHARD_WIDTH in frag.row_columns(4)
+
+
+def test_write_fails_when_replica_down(cluster3):
+    """A write whose replica set is not fully reachable must ERROR, not
+    silently skip the down owner (which union-only anti-entropy could
+    later resurrect stale bits from)."""
+    setup_index(cluster3)
+    cluster3[2].close()
+    cluster3[0].cluster.probe_peers()
+    # find a column whose shard is owned by the dead node
+    cl = cluster3[0].cluster
+    shard = next(s for s in range(32)
+                 if "node2" in cl.placement.shard_nodes("ci", s))
+    col = shard * SHARD_WIDTH + 1
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        query(cluster3[0].port, "ci", f"Set({col}, f=1)")
+    assert exc.value.code == 500
+    assert "unavailable" in exc.value.read().decode()
+
+
+def test_schema_catchup_after_recovery(tmp_path):
+    """DDL issued while a node is down is replayed when it recovers."""
+    servers = make_cluster(tmp_path, n=2, replica_n=1)
+    try:
+        a, b = servers
+        # simulate b being temporarily unreachable
+        a.cluster.by_id["node1"].state = "DOWN"
+        _req(a.port, "POST", "/index/late", {})
+        _req(a.port, "POST", "/index/late/field/f", {})
+        assert b.holder.index("late") is None  # missed the broadcast
+        a.cluster.probe_peers()  # detects recovery, pushes schema
+        assert a.cluster.by_id["node1"].state == "READY"
+        idx = b.holder.index("late")
+        assert idx is not None and idx.field("f") is not None
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_cluster_hosts_config_no_crash(tmp_path):
+    """VERDICT: configuring cluster_hosts used to crash with
+    ModuleNotFoundError (server.py imported a nonexistent module)."""
+    servers = make_cluster(tmp_path, n=2, replica_n=1)
+    try:
+        st = _req(servers[0].port, "GET", "/status")
+        assert st["state"] == "NORMAL"
+        assert len(st["nodes"]) == 2
+    finally:
+        for s in servers:
+            s.close()
