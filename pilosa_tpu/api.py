@@ -173,11 +173,29 @@ class API:
 
     # -- import (api.go:920 Import / :1031 ImportValue / :368 ImportRoaring)
 
+    def _translate_import_keys(self, idx, f, row_keys, column_keys,
+                               row_ids, column_ids):
+        """Key->id translation at the head of the import pipeline
+        (api.go:926-961)."""
+        if column_keys is not None:
+            if not idx.keys:
+                raise ApiError(
+                    "columnKeys not allowed: index 'keys' option disabled")
+            column_ids = idx.translate_store().translate_keys(column_keys)
+        if row_keys is not None:
+            if not f.options.keys:
+                raise ApiError(
+                    "rowKeys not allowed: field 'keys' option disabled")
+            row_ids = f.translate_store().translate_keys(row_keys)
+        return row_ids, column_ids
+
     def import_bits(self, index: str, field: str,
                     row_ids=None, column_ids=None, timestamps=None,
-                    clear: bool = False):
+                    clear: bool = False, row_keys=None, column_keys=None):
         self._validate("Import")
         idx, f = self._index_field(index, field)
+        row_ids, column_ids = self._translate_import_keys(
+            idx, f, row_keys, column_keys, row_ids, column_ids)
         rows = np.asarray(row_ids or [], dtype=np.int64)
         cols = np.asarray(column_ids or [], dtype=np.int64)
         if rows.size != cols.size:
@@ -206,9 +224,12 @@ class API:
             idx.add_existence(cols)
 
     def import_values(self, index: str, field: str,
-                      column_ids=None, values=None, clear: bool = False):
+                      column_ids=None, values=None, clear: bool = False,
+                      column_keys=None):
         self._validate("ImportValue")
         idx, f = self._index_field(index, field)
+        _, column_ids = self._translate_import_keys(
+            idx, f, None, column_keys, None, column_ids)
         cols = np.asarray(column_ids or [], dtype=np.int64)
         vals = np.asarray(values or [], dtype=np.int64)
         if not clear and cols.size != vals.size:

@@ -41,6 +41,8 @@ class Executor:
         mesh builds one over all local devices."""
         self.holder = holder
         self.compiler = PlanCompiler()
+        from .translator import Translator
+        self.translator = Translator(holder)
         self.mesh_exec = None
         if mesh is not None or use_mesh:
             from ..parallel.mesh_exec import MeshExecutor
@@ -48,16 +50,29 @@ class Executor:
 
     # -- entry point (executor.go:113 Execute) -----------------------------
 
-    def execute(self, index_name: str, query, shards=None) -> list[Any]:
+    def execute(self, index_name: str, query, shards=None,
+                translate: bool = True) -> list[Any]:
+        """``translate=False`` for internal (already-translated) requests —
+        the reference's opt.Remote skipping translateCalls
+        (executor.go:147)."""
         if isinstance(query, str):
             query = parse(query)
         idx = self.holder.index(index_name)
         if idx is None:
             raise ExecutionError(f"index not found: {index_name}")
+        if translate:
+            # always runs: validates stray string keys even when no store
+            # is enabled (executor.go:2658 "string 'col' value not
+            # allowed...")
+            query = self.translator.translate_query(index_name, query)
         if shards is None:
             shards = sorted(idx.available_shards())
-        return [self._execute_call(index_name, c, shards)
-                for c in query.calls]
+        results = [self._execute_call(index_name, c, shards)
+                   for c in query.calls]
+        if translate and self.translator.needs_translation(index_name):
+            results = self.translator.translate_results(
+                index_name, query.calls, results)
+        return results
 
     # -- dispatch (executor.go:274 executeCall) ----------------------------
 

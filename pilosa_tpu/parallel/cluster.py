@@ -205,6 +205,66 @@ class InternalClient:
         return data
 
 
+class RemoteTranslateStore:
+    """Key translation routed to the coordinator with a read-through cache
+    — the static-cluster replacement for the reference's primary-writes +
+    streamed-replication scheme (translate.go:35, holder.go:812)."""
+
+    def __init__(self, client: InternalClient, host: str, index: str,
+                 field: str | None):
+        self.client = client
+        self.host = host
+        self.index = index
+        self.field = field
+        self._k2i: dict[str, int] = {}
+        self._i2k: dict[int, str] = {}
+        self._lock = threading.RLock()
+
+    def _path(self) -> str:
+        p = f"/internal/translate/{self.index}"
+        return p + (f"/{self.field}" if self.field else "")
+
+    def translate_key(self, key: str) -> int:
+        with self._lock:
+            kid = self._k2i.get(key)
+        if kid is not None:
+            return kid
+        out = self.client._json(self.host, "POST", self._path(),
+                                {"keys": [key]})
+        kid = out["ids"][0]
+        with self._lock:
+            self._k2i[key] = kid
+            self._i2k[kid] = key
+        return kid
+
+    def translate_keys(self, keys) -> list[int]:
+        return [self.translate_key(k) for k in keys]
+
+    def translate_id(self, kid: int) -> str | None:
+        with self._lock:
+            key = self._i2k.get(kid)
+        if key is not None:
+            return key
+        out = self.client._json(self.host, "POST", self._path(),
+                                {"ids": [kid]})
+        key = out["keys"][0]
+        if key is not None:
+            with self._lock:
+                self._k2i[key] = kid
+                self._i2k[kid] = key
+        return key
+
+    def translate_ids(self, ids) -> list[str | None]:
+        return [self.translate_id(i) for i in ids]
+
+    def find_key(self, key: str) -> int | None:
+        with self._lock:
+            return self._k2i.get(key)
+
+    def close(self):
+        pass
+
+
 # -- node & cluster ---------------------------------------------------------
 
 class Node:
@@ -274,6 +334,12 @@ class Cluster:
     @property
     def is_coordinator(self) -> bool:
         return self.node_id == self.nodes[0].id
+
+    def remote_translate_factory(self, path, index, field):
+        """translate_factory for non-coordinator nodes: route key
+        translation to the coordinator (see RemoteTranslateStore)."""
+        return RemoteTranslateStore(self.client, self.nodes[0].host,
+                                    index, field)
 
     # -- failure detection (cluster.go:1724 confirmNodeDown) ---------------
 
@@ -353,9 +419,19 @@ class Cluster:
         if self.holder.index(index) is None:
             from ..api import NotFoundError
             raise NotFoundError(f"index not found: {index}")
+        # key translation happens ONCE at the coordinating node; fanned-out
+        # internal calls carry ids only (executor.go:147 skips
+        # translateCalls when opt.Remote)
+        translator = self.api.executor.translator
+        query = translator.translate_query(index, query)
         if shards is None:
             shards = self._available_shards(index)
-        return [self._execute_call(index, c, shards) for c in query.calls]
+        results = [self._execute_call(index, c, shards)
+                   for c in query.calls]
+        if translator.needs_translation(index):
+            results = translator.translate_results(index, query.calls,
+                                                   results)
+        return results
 
     def _execute_call(self, index: str, c: Call, shards: list[int]):
         if c.name in ("Set", "Clear"):
@@ -370,7 +446,8 @@ class Cluster:
         return self._execute_read(index, c, shards)
 
     def _local_exec(self, index: str, c: Call, shards: list[int]):
-        return self.api.executor.execute(index, Query([c]), shards)[0]
+        return self.api.executor.execute(index, Query([c]), shards,
+                                         translate=False)[0]
 
     def _ready_owner_order(self, index: str, shard: int) -> list[str]:
         owners = self.placement.shard_nodes(index, shard)
@@ -799,6 +876,27 @@ class Cluster:
 
         router.add("POST", "/internal/import/{index}/{field}",
                    internal_import)
+
+        def internal_translate(req, args):
+            """Coordinator-side key<->id service (http/translator.go)."""
+            idx = cluster.holder.index(args["index"])
+            if idx is None:
+                raise ClusterError(f"index not found: {args['index']}")
+            if "field" in args:
+                f = idx.field(args["field"])
+                if f is None:
+                    raise ClusterError(f"field not found: {args['field']}")
+                store = f.translate_store()
+            else:
+                store = idx.translate_store()
+            body = req.json()
+            if "keys" in body:
+                return {"ids": store.translate_keys(body["keys"])}
+            return {"keys": store.translate_ids(body.get("ids", []))}
+
+        router.add("POST", "/internal/translate/{index}", internal_translate)
+        router.add("POST", "/internal/translate/{index}/{field}",
+                   internal_translate)
 
         def index_shards(req, args):
             idx = cluster.holder.index(args["index"])

@@ -135,15 +135,20 @@ def build_router(api: API, server=None) -> Router:
 
     def post_import(req, args):
         body = req.json()
-        if "values" in body or (body.get("clear") and "rowIDs" not in body):
+        if "values" in body or (body.get("clear")
+                                and "rowIDs" not in body
+                                and "rowKeys" not in body):
             api.import_values(args["index"], args["field"],
                               body.get("columnIDs"), body.get("values"),
-                              clear=body.get("clear", False))
+                              clear=body.get("clear", False),
+                              column_keys=body.get("columnKeys"))
         else:
             api.import_bits(args["index"], args["field"],
                             body.get("rowIDs"), body.get("columnIDs"),
                             body.get("timestamps"),
-                            clear=body.get("clear", False))
+                            clear=body.get("clear", False),
+                            row_keys=body.get("rowKeys"),
+                            column_keys=body.get("columnKeys"))
         return {}
 
     r.add("POST", "/index/{index}/field/{field}/import", post_import)

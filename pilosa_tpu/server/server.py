@@ -113,6 +113,11 @@ class Server:
                 replica_n=self.config.replica_n,
                 holder=self.holder,
             )
+            if not self.cluster.is_coordinator:
+                # key translation lives on the coordinator; replicas route
+                # to it with a read-through cache
+                self.holder.translate_factory = \
+                    self.cluster.remote_translate_factory
         self.api = API(self.holder, cluster=self.cluster, stats=self.stats)
         host, port = self._parse_bind(self.config.bind)
         self.httpd = make_http_server(self.api, host, port, server=self)

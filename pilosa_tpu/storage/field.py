@@ -118,6 +118,9 @@ class Field:
         self._lock = threading.RLock()
         # shards known to have data on remote nodes (field.go:263)
         self.remote_available_shards: set[int] = set()
+        # row-key translation (field.go: per-field TranslateStore)
+        self.translate_factory = None
+        self._translate_store = None
 
         if self.options.type == FIELD_TYPE_INT:
             if self.options.base == 0:
@@ -158,6 +161,23 @@ class Field:
         with self._lock:
             for v in self.views.values():
                 v.close()
+            if self._translate_store is not None:
+                self._translate_store.close()
+                self._translate_store = None
+
+    def translate_store(self):
+        """Row-key store for this field (keys live in <field>/.row_keys)."""
+        with self._lock:
+            if self._translate_store is None:
+                from .translate import TranslateStore
+                path = None if self.path is None \
+                    else os.path.join(self.path, ".row_keys")
+                if self.translate_factory is not None:
+                    self._translate_store = self.translate_factory(
+                        path, self.index, self.name)
+                else:
+                    self._translate_store = TranslateStore(path)
+            return self._translate_store
 
     # -- views -------------------------------------------------------------
 

@@ -17,6 +17,10 @@ class Holder:
         self.path = path
         self.max_op_n = max_op_n
         self.indexes: dict[str, Index] = {}
+        # key-translation store factory propagated to indexes/fields;
+        # None = local file-backed stores (cluster replicas set a
+        # coordinator-routed factory before open())
+        self.translate_factory = None
         self._lock = threading.RLock()
 
     # -- lifecycle (holder.go:137 Open) ------------------------------------
@@ -30,7 +34,10 @@ class Holder:
             if not os.path.isdir(idx_path):
                 continue
             idx = Index(idx_path, name, max_op_n=self.max_op_n)
+            idx.translate_factory = self.translate_factory
             idx.open()
+            for f in idx.fields.values():
+                f.translate_factory = self.translate_factory
             self.indexes[name] = idx
 
     def close(self):
@@ -57,6 +64,7 @@ class Holder:
             idx = Index(self._index_path(name), name, keys=keys,
                         track_existence=track_existence,
                         max_op_n=self.max_op_n, create=True)
+            idx.translate_factory = self.translate_factory
             idx.save_meta()
             self.indexes[name] = idx
             return idx

@@ -34,6 +34,10 @@ class Index:
         self.fields: dict[str, Field] = {}
         self.column_attrs = AttrStore(
             None if path is None else os.path.join(path, ".column_attrs"))
+        # (path, index, field|None) -> store; None = local file-backed
+        # (cluster replicas swap in a coordinator-routed store)
+        self.translate_factory = None
+        self._translate_store = None
         self._lock = threading.RLock()
 
         if create and track_existence:
@@ -73,6 +77,9 @@ class Index:
         with self._lock:
             for f in self.fields.values():
                 f.close()
+            if self._translate_store is not None:
+                self._translate_store.close()
+                self._translate_store = None
 
     # -- fields ------------------------------------------------------------
 
@@ -83,8 +90,25 @@ class Index:
 
     def _make_field(self, name: str,
                     options: FieldOptions | None = None) -> Field:
-        return Field(self._field_path(name), self.name, name, options,
-                     max_op_n=self.max_op_n)
+        f = Field(self._field_path(name), self.name, name, options,
+                  max_op_n=self.max_op_n)
+        f.translate_factory = self.translate_factory
+        return f
+
+    def translate_store(self):
+        """Column-key store for this index (index.go: per-index
+        TranslateStore; keys live in <index>/.keys)."""
+        with self._lock:
+            if self._translate_store is None:
+                from .translate import TranslateStore
+                path = None if self.path is None \
+                    else os.path.join(self.path, ".keys")
+                if self.translate_factory is not None:
+                    self._translate_store = self.translate_factory(
+                        path, self.name, None)
+                else:
+                    self._translate_store = TranslateStore(path)
+            return self._translate_store
 
     def _open_existence_field(self):
         """(index.go:215 openExistenceField): internal `_exists` field,

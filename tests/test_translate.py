@@ -1,0 +1,188 @@
+"""Key translation tests (reference translate.go, executor.go:2610-2907,
+executor_test.go keyed-query cases)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.storage import FieldOptions, Holder
+from pilosa_tpu.storage.translate import TranslateStore
+
+
+# -- store ------------------------------------------------------------------
+
+def test_store_roundtrip(tmp_path):
+    s = TranslateStore(str(tmp_path / "keys"))
+    a = s.translate_key("alpha")
+    b = s.translate_key("beta")
+    assert (a, b) == (1, 2)
+    assert s.translate_key("alpha") == a  # stable
+    assert s.translate_id(a) == "alpha"
+    assert s.translate_id(99) is None
+    assert s.find_key("beta") == b
+    assert s.find_key("nope") is None
+    s.close()
+
+    # replay from the append-only log
+    s2 = TranslateStore(str(tmp_path / "keys"))
+    assert s2.translate_id(1) == "alpha"
+    assert s2.translate_key("beta") == 2
+    assert s2.translate_key("gamma") == 3
+    s2.close()
+
+
+def test_store_entries_from(tmp_path):
+    s = TranslateStore(None)
+    for k in ("a", "b", "c"):
+        s.translate_key(k)
+    assert s.entries_from(1) == [(2, "b"), (3, "c")]
+    assert s.entries_from(3) == []
+
+
+# -- single-node keyed queries ---------------------------------------------
+
+@pytest.fixture
+def keyed_api():
+    h = Holder(None)
+    api = API(h)
+    api.create_index("ki", keys=True)
+    api.create_field("ki", "f", {"keys": True})
+    api.create_field("ki", "plain", {})
+    api.create_field("ki", "b", {"type": "bool"})
+    return api
+
+
+def test_keyed_set_and_row(keyed_api):
+    api = keyed_api
+    [changed] = api.query("ki", 'Set("user123", f="admin")')
+    assert changed is True
+    [row] = api.query("ki", 'Row(f="admin")')
+    assert row.keys == ["user123"]
+    [count] = api.query("ki", 'Count(Row(f="admin"))')
+    assert count == 1
+    # same keys translate to the same ids on re-use
+    api.query("ki", 'Set("user456", f="admin")')
+    [row] = api.query("ki", 'Row(f="admin")')
+    assert sorted(row.keys) == ["user123", "user456"]
+
+
+def test_keyed_topn_and_rows(keyed_api):
+    api = keyed_api
+    for user, role in [("u1", "admin"), ("u2", "admin"), ("u3", "dev"),
+                       ("u4", "admin"), ("u5", "dev"), ("u6", "ops")]:
+        api.query("ki", f'Set("{user}", f="{role}")')
+    [topn] = api.query("ki", "TopN(f, n=2)")
+    assert [(p.key, p.count) for p in topn] == [("admin", 3), ("dev", 2)]
+    [rows] = api.query("ki", "Rows(f)")
+    assert sorted(rows.keys) == ["admin", "dev", "ops"]
+
+
+def test_keyed_groupby(keyed_api):
+    api = keyed_api
+    api.query("ki", 'Set("u1", f="admin") Set("u2", f="admin")')
+    [groups] = api.query("ki", "GroupBy(Rows(f))")
+    assert groups[0].group[0].row_key == "admin"
+    assert groups[0].count == 2
+
+
+def test_unknown_read_key_is_empty(keyed_api):
+    [count] = keyed_api.query("ki", 'Count(Row(f="nobody"))')
+    assert count == 0
+
+
+def test_bool_row_translation(keyed_api):
+    api = keyed_api
+    api.query("ki", 'Set("u1", b=true) Set("u2", b=false)')
+    [row_t] = api.query("ki", "Row(b=true)")
+    assert row_t.keys == ["u1"]
+    [row_f] = api.query("ki", "Row(b=false)")
+    assert row_f.keys == ["u2"]
+
+
+def test_string_keys_rejected_when_disabled():
+    h = Holder(None)
+    api = API(h)
+    api.create_index("plain")
+    api.create_field("plain", "f", {})
+    with pytest.raises(ValueError, match="keys"):
+        api.query("plain", 'Set("user", f=1)')
+    with pytest.raises(ValueError, match="keys"):
+        api.query("plain", 'Row(f="admin")')
+
+
+def test_non_string_rejected_when_keys_enabled(keyed_api):
+    with pytest.raises(ValueError, match="must be a string"):
+        keyed_api.query("ki", "Set(5, f=1)")
+
+
+def test_clear_keyed(keyed_api):
+    api = keyed_api
+    api.query("ki", 'Set("u1", f="admin")')
+    [changed] = api.query("ki", 'Clear("u1", f="admin")')
+    assert changed is True
+    [count] = api.query("ki", 'Count(Row(f="admin"))')
+    assert count == 0
+
+
+def test_keyed_import(keyed_api):
+    api = keyed_api
+    api.import_bits("ki", "f", row_keys=["r1", "r1", "r2"],
+                    column_keys=["c1", "c2", "c3"])
+    [row] = api.query("ki", 'Row(f="r1")')
+    assert sorted(row.keys) == ["c1", "c2"]
+
+
+def test_keys_persist_across_restart(tmp_path):
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    api = API(h)
+    api.create_index("ki", keys=True)
+    api.create_field("ki", "f", {"keys": True})
+    api.query("ki", 'Set("user123", f="admin")')
+    h.close()
+
+    h2 = Holder(str(tmp_path / "d"))
+    h2.open()
+    api2 = API(h2)
+    [row] = api2.query("ki", 'Row(f="admin")')
+    assert row.keys == ["user123"]
+    # new keys continue the sequence, not restart it
+    assert h2.index("ki").translate_store().translate_key("userX") > 1
+    h2.close()
+
+
+# -- cluster round-trip over HTTP ------------------------------------------
+
+def test_keyed_cluster_roundtrip(tmp_path):
+    from tests.test_cluster import make_cluster, _req, query
+
+    servers = make_cluster(tmp_path, n=3, replica_n=2)
+    try:
+        p0 = servers[0].port
+        _req(p0, "POST", "/index/ki", {"options": {"keys": True}})
+        _req(p0, "POST", "/index/ki/field/f",
+             {"options": {"keys": True}})
+        # write via a NON-coordinator node: translation routes to node0
+        p1 = servers[1].port
+        [changed] = query(p1, "ki", 'Set("user123", f="admin")')
+        assert changed is True
+        query(p1, "ki", 'Set("user456", f="admin") Set("user789", f="dev")')
+        # read back via every node
+        for srv in servers:
+            [row] = query(srv.port, "ki", 'Row(f="admin")')
+            assert sorted(row["keys"]) == ["user123", "user456"]
+            [topn] = query(srv.port, "ki", "TopN(f, n=2)")
+            assert [(p["key"], p["count"]) for p in topn] == \
+                [("admin", 2), ("dev", 1)]
+        # keyed import over HTTP through a replica
+        _req(p1, "POST", "/index/ki/field/f/import",
+             {"rowKeys": ["ops", "ops"], "columnKeys": ["userA", "userB"]})
+        [cnt] = query(servers[2].port, "ki", 'Count(Row(f="ops"))')
+        assert cnt == 2
+    finally:
+        for s in servers:
+            s.close()
