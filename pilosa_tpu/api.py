@@ -280,7 +280,7 @@ class API:
         for view_name, data in views.items():
             if not view_name:
                 view_name = "standard"
-            rows, cols_local = unpack_roaring(data)
+            rows, cols_local = unpack_roaring(data, self.holder.max_row_id)
             v = f._create_view_if_not_exists(view_name)
             frag = v.create_fragment_if_not_exists(shard)
             if clear:

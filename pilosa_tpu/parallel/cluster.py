@@ -580,11 +580,27 @@ class Cluster:
 
     def _execute_attr_write(self, index: str, c: Call):
         """Attr stores are replicated on every node (executor.go:2207
-        SetRowAttrs local write + broadcast)."""
+        SetRowAttrs local write + broadcast).  Requires every node READY —
+        a DOWN peer silently skipped would diverge permanently since DDL
+        replay doesn't carry attrs; anti-entropy attr sync repairs the
+        divergence a mid-fan-out failure can still leave."""
+        self._require_ready([n.id for n in self.nodes],
+                            f"{c.name} on {index!r}")
+        # local write FIRST: if it fails, no peer has diverged yet
         out = self._local_exec(index, c, [])
-        for n in self.peers():
-            if n.state == NODE_READY:
-                self.client.query_call(n.host, index, c, [])
+        futures = [self._pool.submit(self.client.query_call, n.host, index,
+                                     c, [])
+                   for n in self.peers()]
+        errors = []
+        for f in futures:
+            try:
+                f.result()
+            except Exception as e:
+                errors.append(str(e))
+        if errors:
+            raise ClusterError(
+                "attr write incomplete (anti-entropy will repair): "
+                + "; ".join(errors))
         return out
 
     # -- reduce (executor.go:2482 reduce fns per call type) ----------------
@@ -653,6 +669,10 @@ class Cluster:
             try:
                 self.client.send_message(n.host, msg)
             except Exception as e:
+                # Mark DOWN so the next successful probe triggers the
+                # apply-schema catch-up; a peer that missed a DDL broadcast
+                # while staying READY would diverge permanently.
+                self._mark_down(n.id)
                 errors.append(f"{n.id}: {e}")
         if errors:
             raise ClusterError("broadcast failed: " + "; ".join(errors))
@@ -822,7 +842,7 @@ class Cluster:
                         host, index, field, view, shard)
                 except Exception:
                     continue
-                rows, cols = unpack_roaring(blob)
+                rows, cols = unpack_roaring(blob, self.holder.max_row_id)
                 idx = self.holder.index(index)
                 frag = idx.field(field)._create_view_if_not_exists(view) \
                     .create_fragment_if_not_exists(shard)

@@ -99,11 +99,11 @@ class Server:
         self.config = config or Config()
         self.logger = Logger(verbose=self.config.verbose)
         self.stats = StatsClient()
-        if self.config.max_row_id > 0:
-            from ..storage.fragment import Fragment
-            Fragment.row_id_cap = self.config.max_row_id
         data_dir = os.path.expanduser(self.config.data_dir)
-        self.holder = Holder(data_dir, max_op_n=self.config.max_op_n)
+        self.holder = Holder(
+            data_dir, max_op_n=self.config.max_op_n,
+            max_row_id=(self.config.max_row_id
+                        if self.config.max_row_id > 0 else None))
         self.cluster = None
         if self.config.cluster_hosts:
             from ..parallel.cluster import Cluster

@@ -64,16 +64,37 @@ def bit_depth(v: int) -> int:
 
 @dataclass
 class FieldOptions:
-    """(field.go:1421 FieldOptions)"""
+    """(field.go:1421 FieldOptions)
+
+    ``min``/``max`` default to None; for int fields an omitted bound
+    resolves to the full int64 range (the reference defaults omitted
+    min/max to MinInt64/MaxInt64, http/handler.go:781) so a bare
+    {"type": "int"} field accepts every value instead of rejecting all
+    non-zero writes against a 0/0 declared range."""
     type: str = FIELD_TYPE_SET
     cache_type: str = CACHE_TYPE_RANKED
     cache_size: int = DEFAULT_CACHE_SIZE
-    min: int = 0
-    max: int = 0
+    min: int | None = None
+    max: int | None = None
     base: int = 0
     bit_depth: int = 0
     time_quantum: str = ""
     keys: bool = False
+
+    def __post_init__(self):
+        if self.type == FIELD_TYPE_INT:
+            # Magnitude is stored sign+magnitude in 63 BSI rows, so the
+            # representable floor is -(2^63-1), not MinInt64; defaulting to
+            # MinInt64 would let set_value(-2**63) silently truncate to 0.
+            if self.min is None:
+                self.min = -((1 << 63) - 1)
+            if self.max is None:
+                self.max = (1 << 63) - 1
+        else:
+            if self.min is None:
+                self.min = 0
+            if self.max is None:
+                self.max = 0
 
     def to_dict(self) -> dict:
         return {
@@ -94,8 +115,8 @@ class FieldOptions:
             type=d.get("type", FIELD_TYPE_SET),
             cache_type=d.get("cacheType", CACHE_TYPE_RANKED),
             cache_size=d.get("cacheSize", DEFAULT_CACHE_SIZE),
-            min=d.get("min", 0),
-            max=d.get("max", 0),
+            min=d.get("min"),
+            max=d.get("max"),
             base=d.get("base", 0),
             bit_depth=d.get("bitDepth", 0),
             time_quantum=d.get("timeQuantum", ""),
@@ -106,12 +127,14 @@ class FieldOptions:
 class Field:
     def __init__(self, path: str | None, index: str, name: str,
                  options: FieldOptions | None = None,
-                 max_op_n: int | None = None):
+                 max_op_n: int | None = None,
+                 row_id_cap: int | None = None):
         self.path = path
         self.index = index
         self.name = name
         self.options = options or FieldOptions()
         self.max_op_n = max_op_n
+        self.row_id_cap = row_id_cap
         self.views: dict[str, View] = {}
         self.row_attrs = AttrStore(
             None if path is None else os.path.join(path, ".row_attrs"))
@@ -192,7 +215,7 @@ class Field:
                 if self.path is not None:
                     vpath = os.path.join(self.path, "views", name)
                 v = View(vpath, self.index, self.name, name,
-                         max_op_n=self.max_op_n)
+                         max_op_n=self.max_op_n, row_id_cap=self.row_id_cap)
                 self.views[name] = v
             return v
 

@@ -51,13 +51,20 @@ class Fragment:
     """One (index, field, view, shard) bitmap."""
 
     def __init__(self, path: str | None, index: str, field: str, view: str,
-                 shard: int, max_op_n: int = DEFAULT_FRAGMENT_MAX_OP_N):
+                 shard: int, max_op_n: int = DEFAULT_FRAGMENT_MAX_OP_N,
+                 row_id_cap: int | None = None):
         self.path = path  # None = purely in-memory (tests)
         self.index = index
         self.field = field
         self.view = view
         self.shard = shard
         self.max_op_n = max_op_n
+        # Guard against hostile row ids forcing terabyte-scale dense
+        # allocations (core.DEFAULT_MAX_ROW_ID); threaded per-instance from
+        # the server config (Holder -> Index -> Field -> View) so multiple
+        # servers in one process keep independent caps.
+        if row_id_cap is not None:
+            self.row_id_cap = row_id_cap
 
         self.words = np.zeros((0, SHARD_WORDS), dtype=np.uint32)
         self._mirrors = {}        # device -> cached jax.Array mirror
@@ -170,9 +177,8 @@ class Fragment:
         nz = np.nonzero(self.words.any(axis=1))[0]
         return int(nz[-1]) if nz.size else 0
 
-    # Configurable guard against hostile row ids forcing terabyte-scale
-    # dense allocations (see core.DEFAULT_MAX_ROW_ID).  Class-level so the
-    # server config can raise it for every fragment at once.
+    # Default cap when none is threaded in (class fallback keeps in-memory
+    # test fragments working without plumbing).
     row_id_cap = DEFAULT_MAX_ROW_ID
 
     def _ensure_rows(self, row_id: int):

@@ -13,9 +13,11 @@ from .field import Field, FieldOptions
 
 class Holder:
     def __init__(self, path: str | None = None,
-                 max_op_n: int | None = None):
+                 max_op_n: int | None = None,
+                 max_row_id: int | None = None):
         self.path = path
         self.max_op_n = max_op_n
+        self.max_row_id = max_row_id  # per-fragment row-id cap (None=default)
         self.indexes: dict[str, Index] = {}
         # key-translation store factory propagated to indexes/fields;
         # None = local file-backed stores (cluster replicas set a
@@ -33,7 +35,8 @@ class Holder:
             idx_path = os.path.join(self.path, name)
             if not os.path.isdir(idx_path):
                 continue
-            idx = Index(idx_path, name, max_op_n=self.max_op_n)
+            idx = Index(idx_path, name, max_op_n=self.max_op_n,
+                        row_id_cap=self.max_row_id)
             idx.translate_factory = self.translate_factory
             idx.open()
             for f in idx.fields.values():
@@ -63,7 +66,8 @@ class Holder:
             validate_name(name, "index name")
             idx = Index(self._index_path(name), name, keys=keys,
                         track_existence=track_existence,
-                        max_op_n=self.max_op_n, create=True)
+                        max_op_n=self.max_op_n, create=True,
+                        row_id_cap=self.max_row_id)
             idx.translate_factory = self.translate_factory
             idx.save_meta()
             self.indexes[name] = idx

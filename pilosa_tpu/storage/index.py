@@ -21,7 +21,8 @@ class IndexError_(ValueError):
 class Index:
     def __init__(self, path: str | None, name: str,
                  keys: bool = False, track_existence: bool = True,
-                 max_op_n: int | None = None, create: bool = False):
+                 max_op_n: int | None = None, create: bool = False,
+                 row_id_cap: int | None = None):
         """``create=True`` for brand-new indexes (materialises the _exists
         field immediately); when reopening from disk, open() reads .meta
         first so a trackExistence=False index is not polluted with a
@@ -31,6 +32,7 @@ class Index:
         self.keys = keys
         self.track_existence = track_existence
         self.max_op_n = max_op_n
+        self.row_id_cap = row_id_cap
         self.fields: dict[str, Field] = {}
         self.column_attrs = AttrStore(
             None if path is None else os.path.join(path, ".column_attrs"))
@@ -91,7 +93,7 @@ class Index:
     def _make_field(self, name: str,
                     options: FieldOptions | None = None) -> Field:
         f = Field(self._field_path(name), self.name, name, options,
-                  max_op_n=self.max_op_n)
+                  max_op_n=self.max_op_n, row_id_cap=self.row_id_cap)
         f.translate_factory = self.translate_factory
         return f
 

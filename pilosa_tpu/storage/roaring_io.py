@@ -39,17 +39,21 @@ class RoaringFormatError(ValueError):
     pass
 
 
-def unpack_roaring(data: bytes) -> tuple[np.ndarray, np.ndarray]:
+def unpack_roaring(data: bytes, row_id_cap: int | None = None
+                   ) -> tuple[np.ndarray, np.ndarray]:
     """Parse a pilosa-roaring blob into (rows, shard-local cols) int64
     arrays (roaring/roaring.go:1258 newRoaringIterator).  Raises
-    RoaringFormatError (a ValueError) on any malformed input."""
+    RoaringFormatError (a ValueError) on any malformed input.
+    ``row_id_cap`` bounds the highest implied row id (defaults to the
+    process-wide DEFAULT_MAX_ROW_ID)."""
     try:
-        return _unpack_roaring(data)
+        return _unpack_roaring(data, row_id_cap)
     except (struct.error, IndexError, OverflowError) as e:
         raise RoaringFormatError(f"malformed roaring data: {e}")
 
 
-def _unpack_roaring(data: bytes) -> tuple[np.ndarray, np.ndarray]:
+def _unpack_roaring(data: bytes, row_id_cap: int | None = None
+                    ) -> tuple[np.ndarray, np.ndarray]:
     if len(data) < 8:
         raise RoaringFormatError("roaring data too short")
     cookie = struct.unpack_from("<I", data, 0)[0]
@@ -68,9 +72,11 @@ def _unpack_roaring(data: bytes) -> tuple[np.ndarray, np.ndarray]:
     # implying a row id above the configured cap BEFORE the signed shift —
     # a key >= 2**47 would overflow int64 and silently alias into valid
     # rows, bypassing the cap (and the allocation guard behind it).
-    from .fragment import Fragment
+    if row_id_cap is None:
+        from ..core import DEFAULT_MAX_ROW_ID
+        row_id_cap = DEFAULT_MAX_ROW_ID
 
-    max_key = (((Fragment.row_id_cap + 1) << SHARD_WIDTH_EXP) - 1) >> 16
+    max_key = (((row_id_cap + 1) << SHARD_WIDTH_EXP) - 1) >> 16
 
     positions = []
     for i in range(n_containers):
@@ -79,7 +85,7 @@ def _unpack_roaring(data: bytes) -> tuple[np.ndarray, np.ndarray]:
         if key > max_key:
             raise RoaringFormatError(
                 f"roaring container key {key} implies a row id above the "
-                f"configured maximum {Fragment.row_id_cap}")
+                f"configured maximum {row_id_cap}")
         n = n_minus1 + 1
         off = struct.unpack_from("<I", data, offsets_off + i * 4)[0]
         base = np.int64(key) << 16

@@ -14,12 +14,14 @@ from .fragment import Fragment
 
 class View:
     def __init__(self, path: str | None, index: str, field: str, name: str,
-                 max_op_n: int | None = None):
+                 max_op_n: int | None = None,
+                 row_id_cap: int | None = None):
         self.path = path
         self.index = index
         self.field = field
         self.name = name
         self.max_op_n = max_op_n
+        self.row_id_cap = row_id_cap
         self.fragments: dict[int, Fragment] = {}
         self._lock = threading.RLock()
 
@@ -38,7 +40,7 @@ class View:
                 if self.max_op_n is not None:
                     kwargs["max_op_n"] = self.max_op_n
                 frag = Fragment(frag_path, self.index, self.field, self.name,
-                                shard, **kwargs)
+                                shard, row_id_cap=self.row_id_cap, **kwargs)
                 self.fragments[shard] = frag
             return frag
 

@@ -197,6 +197,37 @@ def test_field_int_declared_range_enforced():
     assert f.value(1) == (30, True)
 
 
+def test_field_int_unbounded_range_defaults():
+    """An int field created without explicit min/max defaults to the full
+    int64 range (reference http/handler.go:781 MinInt64/MaxInt64) instead
+    of rejecting all non-zero writes against a 0/0 declared range."""
+    f = Field(None, "i", "f", FieldOptions.from_dict({"type": "int"}))
+    assert f.set_value(1, 5)
+    assert f.value(1) == (5, True)
+    assert f.set_value(2, -12345)
+    assert f.value(2) == (-12345, True)
+    # direct-constructed options behave the same
+    f2 = Field(None, "i", "f2", FieldOptions(type="int"))
+    assert f2.set_value(0, 7)
+    assert f2.value(0) == (7, True)
+    # -2**63 is NOT representable in sign+magnitude BSI; it must be
+    # rejected, not silently truncated to 0
+    with pytest.raises(ValueError, match="too low"):
+        f2.set_value(3, -(1 << 63))
+    assert f2.set_value(3, -((1 << 63) - 1))
+    assert f2.value(3) == (-((1 << 63) - 1), True)
+
+
+def test_fragment_row_id_cap_per_instance():
+    """The cap is per-instance (threaded from server config), not a
+    process-wide class global (ADVICE r2)."""
+    small = Fragment(None, "i", "f", "standard", 0, row_id_cap=100)
+    big = Fragment(None, "i", "f", "standard", 1, row_id_cap=10_000)
+    with pytest.raises(ValueError, match="max_row_id"):
+        small.set_bit(101, 0)
+    assert big.set_bit(101, 0)  # independent caps
+
+
 def test_fragment_row_id_cap():
     """Hostile row ids must be rejected before the dense allocation
     (ADVICE: rowIDs=[2**40] would attempt a terabyte-scale allocation)."""
