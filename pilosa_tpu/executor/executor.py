@@ -19,7 +19,7 @@ from ..ops import bitset, bsi
 from ..pql import Call, Query, parse
 from ..storage.field import FIELD_TYPE_INT, FIELD_TYPE_BOOL
 from ..storage import time_quantum as tq
-from .plan import PlanCompiler, PlanError, Resolver
+from .plan import PlanCompiler, PlanError, Resolver, parametrize
 from .results import (
     FieldRow, GroupCount, Pair, RowIdentifiers, RowResult, ValCount,
     merge_pairs, sort_pairs,
@@ -27,10 +27,59 @@ from .results import (
 
 BITMAP_CALLS = {"Row", "Range", "Intersect", "Union", "Difference", "Xor",
                 "Not", "Shift"}
+WRITE_CALLS = {"Set", "Clear", "ClearRow", "Store", "SetRowAttrs",
+               "SetColumnAttrs"}
 
 
 class ExecutionError(ValueError):
     pass
+
+
+class _Pending:
+    """A dispatched-but-unresolved call result.
+
+    Mesh-path aggregations return these so a multi-call query dispatches
+    ALL device work before the first host block (the reference overlaps
+    calls via its worker pool, executor.go:80-110).  ``parts`` are the
+    call's unfetched device arrays; ``fin`` maps their host copies to the
+    final result.  ``execute`` fetches every pending's parts in ONE
+    device->host transfer (concatenated), because each separate fetch is a
+    full dispatch round trip (~100 ms through a tunnel)."""
+
+    __slots__ = ("parts", "fin")
+
+    def __init__(self, parts, fin):
+        self.parts = list(parts)
+        self.fin = fin
+
+
+def _resolve_pendings(results):
+    """Resolve all _Pending results with a single device->host fetch.
+    Parts shared between pendings (batched call groups) fetch once."""
+    pend = [r for r in results if isinstance(r, _Pending)]
+    unique: dict[int, Any] = {}
+    for r in pend:
+        for p in r.parts:
+            unique.setdefault(id(p), p)
+    host: dict[int, np.ndarray] = {}
+    if unique:
+        import jax.numpy as jnp
+        parts = list(unique.values())
+        flat = jnp.concatenate([jnp.ravel(x) for x in parts]) \
+            if len(parts) > 1 else jnp.ravel(parts[0])
+        buf = np.asarray(flat)  # the one blocking fetch
+        off = 0
+        for pid, x in unique.items():
+            n = x.size
+            host[pid] = buf[off:off + n].reshape(x.shape)
+            off += n
+    out = []
+    for r in results:
+        if isinstance(r, _Pending):
+            out.append(r.fin([host[id(p)] for p in r.parts]))
+        else:
+            out.append(r)
+    return out
 
 
 class Executor:
@@ -67,11 +116,130 @@ class Executor:
             query = self.translator.translate_query(index_name, query)
         if shards is None:
             shards = sorted(idx.available_shards())
-        results = [self._execute_call(index_name, c, shards)
-                   for c in query.calls]
+        # Batched grouping reorders dispatch, which is only sound when no
+        # call mutates state a later call could read — mixed write/read
+        # queries run strictly sequentially like the reference.
+        if self.mesh_exec is not None and len(query.calls) > 1 and \
+                not any(c.name in WRITE_CALLS for c in query.calls):
+            results = self._execute_calls_grouped(index_name, query.calls,
+                                                  shards)
+        else:
+            results = [self._execute_call(index_name, c, shards)
+                       for c in query.calls]
+        results = _resolve_pendings(results)
         if translate and self.translator.needs_translation(index_name):
             results = self.translator.translate_results(
                 index_name, query.calls, results)
+        return results
+
+    # -- batched multi-call execution --------------------------------------
+
+    _EMPTY_PARAMS = np.zeros(0, dtype=np.int32)
+
+    def _batch_desc(self, index: str, c: Call):
+        """(group_key, desc) for calls that can batch into one vmapped
+        executable with per-call params rows; None for everything else."""
+        if c.name == "Count" and len(c.children) == 1:
+            slotted, params = parametrize(self._resolve(index,
+                                                        c.children[0]))
+            return (("count", repr(slotted)),
+                    {"kind": "count", "slotted": slotted, "params": params})
+        if c.name == "Sum":
+            f = self._bsi_field(index, c)
+            fp = self._filter_plan(index, c)
+            slotted, params = (None, self._EMPTY_PARAMS) if fp is None \
+                else parametrize(fp)
+            return (("sum", f.name, repr(slotted)),
+                    {"kind": "sum", "slotted": slotted, "params": params,
+                     "field": f.name, "view": f.bsi_view_name(),
+                     "base": f.options.base})
+        if c.name == "TopN":
+            field_name, ok = c.string_arg("_field")
+            if not ok or self.holder.field(index, field_name) is None:
+                return None  # per-call path raises the proper error
+            fp = self._filter_plan(index, c)
+            slotted, params = (None, self._EMPTY_PARAMS) if fp is None \
+                else parametrize(fp)
+            n, _ = c.uint_arg("n")
+            return (("topn", field_name, repr(slotted)),
+                    {"kind": "topn", "slotted": slotted, "params": params,
+                     "field": field_name, "ids": c.args.get("ids"), "n": n})
+        return None
+
+    def _execute_calls_grouped(self, index: str, calls, shards):
+        """Group same-shape Count/TopN/Sum calls and execute each group as
+        ONE device computation over stacked params — the worker-pool
+        equivalent for a multi-call query (executor.go:80-110), minus N-1
+        dispatch round trips."""
+        descs: list = [None] * len(calls)
+        groups: dict[tuple, list[int]] = {}
+        for i, c in enumerate(calls):
+            kd = self._batch_desc(index, c)
+            if kd is not None:
+                key, d = kd
+                descs[i] = d
+                groups.setdefault(key, []).append(i)
+
+        results: list = [None] * len(calls)
+        batched: set[int] = set()
+        for key, idxs in groups.items():
+            if len(idxs) < 2:
+                continue
+            ds = [descs[i] for i in idxs]
+            kind = ds[0]["kind"]
+            params_mat = np.stack([d["params"] for d in ds])
+            if kind == "count":
+                parts = self.mesh_exec.count_batch_async(
+                    ds[0]["slotted"], params_mat, self.holder, index, shards)
+                for b, i in enumerate(idxs):
+                    results[i] = _Pending(
+                        parts,
+                        lambda hp, b=b: sum(int(p[b]) for p in hp))
+            elif kind == "sum":
+                parts = self.mesh_exec.bsi_sum_batch_async(
+                    ds[0]["field"], ds[0]["view"], ds[0]["slotted"],
+                    params_mat, self.holder, index, shards)
+                base = ds[0]["base"]
+
+                def _sum_fin(hp, b, base=base):
+                    total, cnt = 0, 0
+                    for p in hp:
+                        s, c_ = bsi.weighted_sum(p[b])
+                        total += s
+                        cnt += c_
+                    return ValCount(total + cnt * base, cnt)
+
+                for b, i in enumerate(idxs):
+                    results[i] = _Pending(
+                        parts, lambda hp, b=b: _sum_fin(hp, b))
+            else:  # topn
+                parts = self.mesh_exec.row_counts_batch_async(
+                    ds[0]["field"], VIEW_STANDARD, ds[0]["slotted"],
+                    params_mat, self.holder, index, shards)
+
+                def _topn_fin(hp, b, ids, n):
+                    counts = self.mesh_exec.merge_counts(
+                        [p[b] for p in hp])
+                    if ids:
+                        pairs = [Pair(int(i), int(counts[i]))
+                                 for i in ids if i < counts.size]
+                    else:
+                        nz = np.nonzero(counts)[0]
+                        pairs = [Pair(int(i), int(counts[i])) for i in nz]
+                    pairs = [p for p in pairs if p.count > 0]
+                    return sort_pairs(pairs, n or None)
+
+                for b, i in enumerate(idxs):
+                    d = descs[i]
+                    results[i] = _Pending(
+                        parts,
+                        lambda hp, b=b, ids=d["ids"], n=d["n"]:
+                        _topn_fin(hp, b, ids, n))
+            batched.update(idxs)
+
+        for i, c in enumerate(calls):
+            if i not in batched:
+                results[i] = self._execute_call(index, c, shards)
         return results
 
     # -- dispatch (executor.go:274 executeCall) ----------------------------
@@ -134,7 +302,9 @@ class Executor:
             raise ExecutionError("Count() requires one input")
         plan = self._resolve(index, c.children[0])
         if self.mesh_exec is not None:
-            return self.mesh_exec.count(plan, self.holder, index, shards)
+            parts = self.mesh_exec.count_async(plan, self.holder, index,
+                                               shards)
+            return _Pending(parts, lambda hp: sum(int(x) for x in hp))
         counts = [
             self.compiler.execute_shard(plan, self.holder, index, shard,
                                         reducer="count")
@@ -163,11 +333,33 @@ class Executor:
         plan = self._resolve(index, c.children[0])
         return self._plan_segments(plan, index, shards)
 
+    def _filter_plan(self, index: str, c: Call):
+        """Resolve the optional filter child to a plan (mesh path fuses it
+        into the same shard_map computation instead of materialising
+        per-shard segments first)."""
+        if not c.children:
+            return None
+        return self._resolve(index, c.children[0])
+
     def _execute_sum(self, index: str, c: Call, shards) -> ValCount:
         """(executor.go:406 executeSum + fragment.go:1111 sum)"""
         f = self._bsi_field(index, c)
-        filters = self._filter_segments(index, c, shards)
         view = f.bsi_view_name()
+        if self.mesh_exec is not None:
+            parts = self.mesh_exec.bsi_sum_async(
+                f.name, view, self._filter_plan(index, c), self.holder,
+                index, shards)
+
+            def _fin(hp, base=f.options.base):
+                total, n = 0, 0
+                for p in hp:
+                    s, cnt = bsi.weighted_sum(p)
+                    total += s
+                    n += cnt
+                return ValCount(total + n * base, n)
+
+            return _Pending(parts, _fin)
+        filters = self._filter_segments(index, c, shards)
         total, n = 0, 0
         for shard in shards:
             frag = self.holder.fragment(index, f.name, view, shard)
@@ -186,9 +378,17 @@ class Executor:
                          want_max: bool) -> ValCount:
         """(executor.go:437 executeMin/:472 executeMax)"""
         f = self._bsi_field(index, c)
-        filters = self._filter_segments(index, c, shards)
         view = f.bsi_view_name()
         acc = ValCount()
+        if self.mesh_exec is not None:
+            per_shard = self.mesh_exec.bsi_min_max(
+                f.name, view, self._filter_plan(index, c), self.holder,
+                index, shards, want_max=want_max)
+            for val, cnt in per_shard:
+                vc = ValCount(val + f.options.base if cnt else 0, cnt)
+                acc = acc.larger(vc) if want_max else acc.smaller(vc)
+            return acc
+        filters = self._filter_segments(index, c, shards)
         for shard in shards:
             frag = self.holder.fragment(index, f.name, view, shard)
             if frag is None or frag.n_rows < bsi.OFFSET_ROW + 1:
@@ -212,6 +412,14 @@ class Executor:
         f = self.holder.field(index, field_name)
         if f is None:
             raise ExecutionError(f"field not found: {field_name}")
+        if self.mesh_exec is not None:
+            counts = self.mesh_exec.row_counts(
+                field_name, VIEW_STANDARD, None, self.holder, index, shards)
+            nz = np.nonzero(counts)[0]
+            if nz.size == 0:
+                return ValCount(0, 0)
+            rid = int(nz[-1] if want_max else nz[0])
+            return ValCount(rid, int(counts[rid]))
         best, best_count = None, 0
         v = f.view(VIEW_STANDARD)
         for shard in shards:
@@ -240,8 +448,29 @@ class Executor:
             raise ExecutionError(f"field not found: {field_name}")
         n, _ = c.uint_arg("n")
         ids = c.args.get("ids")
-        filters = self._filter_segments(index, c, shards)
 
+        if self.mesh_exec is not None:
+            # one shard_map computation: per-row popcounts masked by the
+            # filter plan, psum'd over the shard axis (fragment.go:1570 top
+            # collapsed into a single ICI all-reduce)
+            parts = self.mesh_exec.row_counts_async(
+                field_name, VIEW_STANDARD, self._filter_plan(index, c),
+                self.holder, index, shards)
+
+            def _fin(hp, ids=ids, n=n):
+                counts = self.mesh_exec.merge_counts(hp)
+                if ids:
+                    pairs = [Pair(int(i), int(counts[i]))
+                             for i in ids if i < counts.size]
+                else:
+                    nz = np.nonzero(counts)[0]
+                    pairs = [Pair(int(i), int(counts[i])) for i in nz]
+                pairs = [p for p in pairs if p.count > 0]
+                return sort_pairs(pairs, n or None)
+
+            return _Pending(parts, _fin)
+
+        filters = self._filter_segments(index, c, shards)
         v = f.view(VIEW_STANDARD)
         per_shard: list[list[Pair]] = []
         for shard in shards:
@@ -299,6 +528,11 @@ class Executor:
             v = f.view(vname)
             if v is None:
                 continue
+            if self.mesh_exec is not None and column is None:
+                counts = self.mesh_exec.row_counts(
+                    field_name, vname, None, self.holder, index, shards)
+                row_ids.update(int(i) for i in np.nonzero(counts)[0])
+                continue
             for shard in shards:
                 if column is not None and column // SHARD_WIDTH != shard:
                     continue
@@ -348,18 +582,10 @@ class Executor:
             ids = self._execute_rows(index, rc, shards).rows
             fields.append((fname, ids))
 
-        filter_segs = None
-        if filt_call is not None:
-            plan = self._resolve(index, filt_call)
-            filter_segs = {
-                s: self.compiler.execute_shard(plan, self.holder, index, s)
-                for s in shards
-            }
-
         # Count each combination: per shard, AND the group rows' segments +
         # optional filter, popcount.  The innermost field is batched on
-        # device via intersection_counts_matrix when the group prefix is a
-        # single segment (the common case).
+        # device; on the mesh path the whole inner loop is ONE psum'd
+        # shard_map call per combo with dynamic prefix row ids.
         results: list[GroupCount] = []
         last_field, last_ids = fields[-1]
         prefix_fields = fields[:-1]
@@ -371,6 +597,36 @@ class Executor:
             fname, ids = prefix_fields[i]
             for rid in ids:
                 yield from prefix_combos(i + 1, combo + ((fname, rid),))
+
+        if self.mesh_exec is not None:
+            filter_plan = (self._resolve(index, filt_call)
+                           if filt_call is not None else None)
+            prefix_keys = [(fname, VIEW_STANDARD) for fname, _ in
+                           prefix_fields]
+            for combo in prefix_combos():
+                counts = self.mesh_exec.group_counts(
+                    (last_field, VIEW_STANDARD), prefix_keys,
+                    [rid for _, rid in combo], filter_plan, self.holder,
+                    index, shards)
+                for rid in last_ids:
+                    cnt = int(counts[rid]) if rid < counts.size else 0
+                    if cnt > 0:
+                        group = [FieldRow(fn, ri) for fn, ri in combo]
+                        group.append(FieldRow(last_field, rid))
+                        results.append(GroupCount(group, cnt))
+            results.sort(key=lambda g: tuple(
+                (fr.field, fr.row_id) for fr in g.group))
+            if limit is not None:
+                results = results[:limit]
+            return results
+
+        filter_segs = None
+        if filt_call is not None:
+            plan = self._resolve(index, filt_call)
+            filter_segs = {
+                s: self.compiler.execute_shard(plan, self.holder, index, s)
+                for s in shards
+            }
 
         last_pos = {r: j for j, r in enumerate(last_ids)}
         for combo in prefix_combos():

@@ -77,6 +77,63 @@ class ConstPlan:
     """All-zero segment."""
 
 
+@dataclass(frozen=True)
+class Slot:
+    """Dynamic-parameter placeholder inside a plan.
+
+    ``parametrize`` replaces literal row ids and BSI predicate values with
+    Slots so the compiled executable is keyed by call-tree SHAPE — every
+    ``Count(Row(f=N))`` shares one XLA program with N as a runtime argument
+    (SURVEY §7 "one XLA computation per request ... cache keyed by call
+    tree shape").  ``idx`` indexes the int32 params vector; ``sign``
+    ("pos"/"zero"/"neg", BSI slots only) and ``width`` are structural."""
+    idx: int
+    sign: str = ""
+    width: int = 1
+
+    def __repr__(self):
+        return f"${self.idx}:{self.sign}:{self.width}"
+
+
+def parametrize(plan):
+    """Replace literal row ids / BSI values with Slots; returns
+    (slotted_plan, params int32[P]).  repr(slotted_plan) is the shape cache
+    key; params ride as a runtime argument."""
+    params: list[int] = []
+
+    def slot_row(row_id: int) -> Slot:
+        s = Slot(len(params))
+        params.append(int(row_id))
+        return s
+
+    def slot_value(value: int) -> Slot:
+        sign = "zero" if value == 0 else ("pos" if value > 0 else "neg")
+        s = Slot(len(params), sign, bsi.MAG_BITS)
+        mag = abs(int(value))
+        params.extend((mag >> i) & 1 for i in range(bsi.MAG_BITS))
+        return s
+
+    def walk(p):
+        if isinstance(p, RowPlan):
+            return RowPlan(p.field, p.views, slot_row(p.row_id))
+        if isinstance(p, BSIPlan):
+            if p.op in ("notnull", "empty"):
+                return p
+            if p.op == "between":
+                return BSIPlan(p.field, p.view, p.op,
+                               slot_value(p.value), slot_value(p.value2))
+            return BSIPlan(p.field, p.view, p.op, slot_value(p.value), 0)
+        if isinstance(p, NotPlan):
+            return NotPlan(walk(p.existence), walk(p.child))
+        if isinstance(p, ShiftPlan):
+            return ShiftPlan(walk(p.child), p.n)
+        if isinstance(p, NaryPlan):
+            return NaryPlan(p.op, tuple(walk(ch) for ch in p.children))
+        return p  # ConstPlan
+
+    return walk(plan), np.asarray(params, dtype=np.int32)
+
+
 # -- resolution: pql.Call -> plan IR ---------------------------------------
 
 class Resolver:
@@ -270,18 +327,37 @@ def plan_inputs(plan) -> list[tuple[str, str]]:
     return out
 
 
-def eval_plan(plan, frags: dict[tuple[str, str], Any]):
+def eval_plan(plan, frags: dict[tuple[str, str], Any], params=None):
     """Trace a plan over fragment tensors.  ``frags`` maps (field, view) to a
-    uint32[n_rows, W] array or None (missing fragment).  Returns uint32[W]."""
+    uint32[n_rows, W] array or None (missing fragment).  Returns uint32[W].
+
+    Literal plans trace their constants into the program; slotted plans
+    (``parametrize``) read row ids / predicate bits from the traced
+    ``params`` vector so the compiled program is value-independent."""
 
     def zero():
         return jnp.zeros(SHARD_WORDS, dtype=jnp.uint32)
 
     def get_row(field, view, row_id):
         frag = frags.get((field, view))
-        if frag is None or row_id >= frag.shape[0]:
+        if frag is None:
+            return None
+        if isinstance(row_id, Slot):
+            if frag.shape[0] == 0:
+                return None
+            rid = params[row_id.idx]
+            return jnp.where(
+                rid < frag.shape[0],
+                jax.lax.dynamic_index_in_dim(
+                    frag, jnp.minimum(rid, frag.shape[0] - 1), axis=0,
+                    keepdims=False),
+                jnp.zeros(frag.shape[-1], dtype=frag.dtype))
+        if row_id >= frag.shape[0]:
             return None
         return frag[row_id]
+
+    def mag_bits(slot: Slot):
+        return params[slot.idx:slot.idx + slot.width]
 
     def ev(p):
         if isinstance(p, ConstPlan):
@@ -300,6 +376,13 @@ def eval_plan(plan, frags: dict[tuple[str, str], Any]):
                 return zero()
             if p.op == "notnull":
                 return bsi.not_null(frag)
+            if isinstance(p.value, Slot):
+                if p.op == "between":
+                    return bsi.range_between_dyn(
+                        frag, p.value.sign, mag_bits(p.value),
+                        p.value2.sign, mag_bits(p.value2))
+                return bsi.range_op_dyn(frag, p.op, p.value.sign,
+                                        mag_bits(p.value))
             if p.op == "between":
                 return bsi.range_between(frag, p.value, p.value2)
             return bsi.range_op(frag, p.op, p.value)
@@ -329,9 +412,10 @@ def eval_plan(plan, frags: dict[tuple[str, str], Any]):
 
 
 class PlanCompiler:
-    """Caches jitted executables keyed by (plan repr, reducer, input shape
-    signature) — the "one XLA computation per request" cache
-    (SURVEY.md §7)."""
+    """Caches jitted executables keyed by (plan SHAPE repr, reducer, input
+    shape signature) — the "one XLA computation per request" cache
+    (SURVEY.md §7).  Plans are parametrized first, so distinct row ids /
+    predicate values reuse one executable with fresh runtime params."""
 
     REDUCERS = {
         None: lambda seg: seg,
@@ -341,17 +425,17 @@ class PlanCompiler:
     def __init__(self):
         self._cache: dict = {}
 
-    def compiled(self, plan, input_keys, shapes, reducer=None):
-        key = (repr(plan), tuple(input_keys), tuple(shapes), reducer)
+    def compiled(self, slotted_plan, input_keys, shapes, reducer=None):
+        key = (repr(slotted_plan), tuple(input_keys), tuple(shapes), reducer)
         fn = self._cache.get(key)
         if fn is None:
             reduce_fn = self.REDUCERS[reducer]
 
-            def run(*arrays):
+            def run(params, *arrays):
                 frags = {
                     k: a for k, a in zip(input_keys, arrays) if a is not None
                 }
-                return reduce_fn(eval_plan(plan, frags))
+                return reduce_fn(eval_plan(slotted_plan, frags, params))
 
             fn = jax.jit(run)
             self._cache[key] = fn
@@ -360,6 +444,7 @@ class PlanCompiler:
     def execute_shard(self, plan, holder, index_name: str, shard: int,
                       reducer=None):
         """Gather device inputs for one shard and run the compiled plan."""
+        slotted, params = parametrize(plan)
         keys = plan_inputs(plan)
         arrays = []
         for field, view in keys:
@@ -367,5 +452,5 @@ class PlanCompiler:
             arrays.append(None if frag is None else frag.device())
         shapes = tuple(
             None if a is None else a.shape for a in arrays)
-        fn = self.compiled(plan, keys, shapes, reducer)
-        return fn(*[a for a in arrays])
+        fn = self.compiled(slotted, keys, shapes, reducer)
+        return fn(jnp.asarray(params), *arrays)

@@ -127,6 +127,87 @@ def range_between(bsi_frag, lo: int, hi: int, filter_seg=None):
     return ge & le
 
 
+# -- dynamic-predicate variants ---------------------------------------------
+# The predicate magnitude arrives as a traced bit vector instead of a Python
+# int, so every query against the same field shape shares ONE compiled
+# executable (the plan cache is keyed by call-tree shape, SURVEY §7) — the
+# per-slice branch on the predicate bit becomes a select.
+
+MAG_BITS = 63  # max magnitude bits of an int64 predicate
+
+
+def _magnitude_compare_dyn(bsi_frag, mag_bits, candidates):
+    """_magnitude_compare with the predicate's bits as a traced int32[63]
+    vector (LSB first).  Bits at positions >= depth mean the predicate
+    exceeds the representable range: everything is less."""
+    depth = depth_of(bsi_frag)
+    eq = candidates
+    lt = jnp.zeros_like(candidates)
+    gt = jnp.zeros_like(candidates)
+    for i in range(depth - 1, -1, -1):
+        bit = bsi_frag[OFFSET_ROW + i]
+        b = mag_bits[i] > 0
+        new_lt = jnp.where(b, lt | (eq & ~bit), lt)
+        new_gt = jnp.where(b, gt, gt | (eq & bit))
+        eq = jnp.where(b, eq & bit, eq & ~bit)
+        lt, gt = new_lt, new_gt
+    if depth < MAG_BITS:
+        ovf = jnp.sum(mag_bits[depth:MAG_BITS]) > 0
+        lt = jnp.where(ovf, lt | eq | gt, lt)
+        eq = jnp.where(ovf, jnp.zeros_like(eq), eq)
+        gt = jnp.where(ovf, jnp.zeros_like(gt), gt)
+    return lt, eq, gt
+
+
+def range_op_dyn(bsi_frag, op: str, sign: str, mag_bits, filter_seg=None):
+    """range_op with a dynamic predicate: ``sign`` ("pos"|"zero"|"neg") is
+    structural (it selects the code path), ``mag_bits`` is the traced
+    magnitude bit vector."""
+    exists = not_null(bsi_frag, filter_seg)
+    sgn = bsi_frag[SIGN_ROW]
+    pos = exists & ~sgn
+    neg = exists & sgn
+
+    if sign == "pos":
+        plt, peq, pgt = _magnitude_compare_dyn(bsi_frag, mag_bits, pos)
+        lt = neg | plt
+        eq = peq
+        gt = pgt
+    elif sign == "zero":
+        # predicate 0 needs no dynamic bits (the zero compare is static)
+        plt, peq, pgt = _magnitude_compare(bsi_frag, 0, pos)
+        _, neg_zero, _ = _magnitude_compare(bsi_frag, 0, neg)
+        eq = peq | neg_zero
+        lt = neg & ~neg_zero
+        gt = pgt
+    else:
+        nlt, neq_, ngt = _magnitude_compare_dyn(bsi_frag, mag_bits, neg)
+        lt = ngt
+        eq = neq_
+        gt = pos | nlt
+
+    if op == "eq":
+        return eq
+    if op == "neq":
+        return exists & ~eq
+    if op == "lt":
+        return lt
+    if op == "le":
+        return lt | eq
+    if op == "gt":
+        return gt
+    if op == "ge":
+        return gt | eq
+    raise ValueError(f"unknown range op {op!r}")
+
+
+def range_between_dyn(bsi_frag, lo_sign, lo_bits, hi_sign, hi_bits,
+                      filter_seg=None):
+    ge = range_op_dyn(bsi_frag, "ge", lo_sign, lo_bits, filter_seg)
+    le = range_op_dyn(bsi_frag, "le", hi_sign, hi_bits, filter_seg)
+    return ge & le
+
+
 def sum_counts(bsi_frag, filter_seg=None):
     """Device half of Sum (fragment.go:1111): per-bit-slice popcounts split by
     sign.  Returns int32[2, depth+1]: row 0 = positive-side counts (count of

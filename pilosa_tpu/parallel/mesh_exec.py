@@ -5,9 +5,29 @@ The reference fans per-shard jobs to a goroutine pool and a star reduce
 with identical plan input shapes are STACKED into [S, rows, W] tensors,
 sharded over a 1-d "shards" mesh axis, and the whole batch executes as one
 XLA computation under shard_map: each device runs the vmapped plan on its
-local shard block and cross-shard reductions (Count, per-row counts for
-TopN) ride ICI collectives (psum) instead of host gather — the star reduce
-becomes an all-reduce.
+local shard block and cross-shard reductions ride ICI collectives (psum)
+instead of host gather — the star reduce becomes an all-reduce.
+
+Reducers (each one compiled executable per input-shape signature):
+
+* ``count``      — popcount-sum of the plan result, psum over shards
+                   (Count; executor.go:1790).
+* ``segments``   — raw per-shard plan results (bitmap calls).
+* ``row_counts`` — per-row popcounts of a field fragment masked by an
+                   optional filter plan, psum over shards (TopN phase,
+                   Rows, MinRow/MaxRow; fragment.go:1570 top).
+* ``bsi_sum``    — per-bit-slice popcounts of a BSI fragment under an
+                   optional filter, psum over shards; host does the exact
+                   2^i weighting (Sum; fragment.go:1111).
+* ``bsi_min_max``— per-shard MSB-first extremum scan, gathered to host
+                   for the final (tiny) cross-shard reduce (Min/Max;
+                   fragment.go:1147).
+* ``group_counts`` — per-row popcounts of a field fragment masked by the
+                   intersection of dynamically-indexed prefix rows + an
+                   optional filter plan, psum over shards (GroupBy inner
+                   loop; executor.go:1068).  Prefix row ids are dynamic
+                   arguments so every combo of a GroupBy shares ONE
+                   compiled executable.
 
 On a single device this degrades gracefully to one stacked call (still
 better than per-shard dispatch given the ~100 ms tunnel round-trip floor).
@@ -20,8 +40,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops import bitset
-from ..executor.plan import eval_plan, plan_inputs
+from ..ops import bitset, bsi
+from ..executor.plan import eval_plan, parametrize, plan_inputs
 
 SHARD_AXIS = "shards"
 
@@ -49,12 +69,37 @@ class MeshExecutor:
         self.stage_device = None if stage.platform == default_platform \
             else stage
         self._cache: dict = {}
+        # (index, keys, shards) -> (mirror-id token, groups) — the stacked
+        # + mesh-placed input blocks, rebuilt only when a fragment's device
+        # mirror changes (a write re-uploads it).  Without this every query
+        # would re-stack its input fragments on device.  LRU-bounded: a
+        # stale entry (shard set grew, index deleted) pins a full stacked
+        # copy of its fragments in device memory until evicted.
+        from collections import OrderedDict
+        self._stack_cache: OrderedDict = OrderedDict()
+        self.stack_cache_max = 64
 
     # -- compiled executables ---------------------------------------------
 
-    def _compiled(self, plan, input_keys, shapes, reducer):
-        key = (repr(plan), tuple(input_keys), tuple(shapes), reducer,
-               id(self.mesh))
+    def _jit_shard_map(self, key, block_fn, in_specs, out_specs):
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = jax.jit(jax.shard_map(
+                block_fn, mesh=self.mesh,
+                in_specs=in_specs, out_specs=out_specs))
+            self._cache[key] = fn
+        return fn
+
+    def _plan_key(self, kind, plan, input_keys, shapes, extra=()):
+        return (kind, repr(plan), tuple(input_keys), tuple(shapes),
+                tuple(extra), id(self.mesh))
+
+    def _compiled(self, slotted_plan, input_keys, shapes, reducer):
+        """``slotted_plan`` comes from ``parametrize``: the executable is
+        keyed by plan SHAPE; row ids / predicate bits ride in the params
+        vector (replicated across the mesh, P() spec)."""
+        key = self._plan_key(reducer or "segments", slotted_plan, input_keys,
+                             shapes)
         fn = self._cache.get(key)
         if fn is not None:
             return fn
@@ -62,53 +107,40 @@ class MeshExecutor:
         # input_keys here are only the PRESENT fragments; missing ones are
         # omitted from the arg list entirely (shard_map specs must map 1:1
         # to array args)
-        def per_shard(*arrays):
+        def per_shard(params, *arrays):
             frags = dict(zip(input_keys, arrays))
-            return eval_plan(plan, frags)
+            return eval_plan(slotted_plan, frags, params)
 
-        vmapped = jax.vmap(per_shard)
+        vmapped = jax.vmap(per_shard,
+                           in_axes=(None,) + (0,) * len(shapes))
 
         if reducer == "count":
-            def block_fn(*arrays):
-                segs = vmapped(*arrays)  # [S_local, W]
+            def block_fn(params, *arrays):
+                segs = vmapped(params, *arrays)  # [S_local, W]
                 local = jnp.sum(
                     jax.lax.population_count(segs).astype(jnp.int32))
                 return jax.lax.psum(local, axis_name=SHARD_AXIS)
 
             out_specs = P()
-        elif reducer == "row_counts":
-            # per-(shard-row) popcounts of the first input fragment masked
-            # by the plan result — TopN phase 1, reduced over shards on ICI
-            def block_fn(*arrays):
-                segs = vmapped(*arrays)            # [S_local, W]
-                frag = arrays[0]                   # [S_local, rows, W]
-                masked = frag & segs[:, None, :] if segs is not None else frag
-                counts = jnp.sum(
-                    jax.lax.population_count(masked).astype(jnp.int32),
-                    axis=(0, 2))                   # [rows]
-                return jax.lax.psum(counts, axis_name=SHARD_AXIS)
-
-            out_specs = P()
         else:
-            def block_fn(*arrays):
-                return vmapped(*arrays)            # [S_local, W]
+            def block_fn(params, *arrays):
+                return vmapped(params, *arrays)    # [S_local, W]
 
             out_specs = P(SHARD_AXIS)
 
-        in_specs = tuple(P(SHARD_AXIS) for _ in shapes)
-        fn = jax.jit(jax.shard_map(
-            block_fn, mesh=self.mesh,
-            in_specs=in_specs, out_specs=out_specs))
-        self._cache[key] = fn
-        return fn
+        in_specs = (P(),) + tuple(P(SHARD_AXIS) for _ in shapes)
+        return self._jit_shard_map(key, block_fn, in_specs, out_specs)
 
     # -- shard grouping ----------------------------------------------------
 
-    def _gather_inputs(self, plan, holder, index, shards):
-        """Group shards by input-shape signature; returns
-        [(shard_list, input_keys, stacked_arrays, shapes)]."""
-        keys = plan_inputs(plan)
-        groups: dict[tuple, list[tuple[int, list]]] = {}
+    def _placed_groups(self, keys, holder, index, shards):
+        """Group shards by input-shape signature over fragment keys
+        [(field, view), ...] and stack+place each group's fragments over
+        the mesh axis.  Returns [(shard_list, placed_per_key, shapes)];
+        ``placed_per_key[i]`` is None when key i's fragment is absent in
+        the whole group.  Results are cached against the fragments' device
+        mirrors so repeat queries reuse the resident blocks."""
+        per_shard: list[list] = []
         for shard in shards:
             arrays = []
             for field, view in keys:
@@ -116,18 +148,36 @@ class MeshExecutor:
                 arrays.append(
                     None if frag is None
                     else frag.device(self.stage_device))
+            per_shard.append(arrays)
+        token = tuple(0 if a is None else id(a)
+                      for arrays in per_shard for a in arrays)
+        ckey = (index, tuple(keys), tuple(shards))
+        cached = self._stack_cache.get(ckey)
+        if cached is not None and cached[0] == token:
+            self._stack_cache.move_to_end(ckey)
+            return cached[1]
+
+        groups: dict[tuple, list[tuple[int, list]]] = {}
+        for shard, arrays in zip(shards, per_shard):
             sig = tuple(None if a is None else a.shape for a in arrays)
             groups.setdefault(sig, []).append((shard, arrays))
         out = []
         for sig, members in groups.items():
             shard_list = [m[0] for m in members]
-            stacked = []
+            placed = []
             for i, shape in enumerate(sig):
                 if shape is None:
-                    stacked.append(None)
+                    placed.append(None)
                 else:
-                    stacked.append([m[1][i] for m in members])
-            out.append((shard_list, keys, stacked, sig))
+                    placed.append(self._pad_and_place(
+                        [m[1][i] for m in members], shape, len(members)))
+            out.append((shard_list, placed, sig))
+        # token holds mirror ids; keeping per_shard alive pins the mirrors
+        # so ids stay valid for the cache's lifetime
+        self._stack_cache[ckey] = (token, out, per_shard)
+        self._stack_cache.move_to_end(ckey)
+        while len(self._stack_cache) > self.stack_cache_max:
+            self._stack_cache.popitem(last=False)
         return out
 
     def _pad_and_place(self, arrays_list, shape, n: int):
@@ -143,41 +193,457 @@ class MeshExecutor:
         sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
         return jax.device_put(stacked, sharding)
 
+    @staticmethod
+    def _present(keys, placed, sig):
+        return [(k, a, s) for k, a, s in zip(keys, placed, sig)
+                if s is not None]
+
+    def _filter_keys(self, filter_plan) -> list[tuple[str, str]]:
+        return plan_inputs(filter_plan) if filter_plan is not None else []
+
     # -- public entry points ----------------------------------------------
 
-    def count(self, plan, holder, index, shards) -> int:
-        total = 0
-        for shard_list, keys, stacked, sig in self._gather_inputs(
-                plan, holder, index, shards):
+    def count_async(self, plan, holder, index, shards) -> list:
+        """Dispatch the count computation; returns unblocked device scalars
+        (one per shape group).  jax's async dispatch lets a batch of calls
+        overlap on device; block once via int() at the end
+        (``Executor.execute`` resolves all calls' pendings after dispatch)."""
+        keys = plan_inputs(plan)
+        slotted, params = parametrize(plan)
+        params = jnp.asarray(params)
+        parts = []
+        for shard_list, placed, sig in self._placed_groups(
+                keys, holder, index, shards):
             if all(s is None for s in sig):
                 continue  # no fragments -> plan evaluates to empty
-            n = len(shard_list)
-            present = [(k, a, s) for k, a, s in zip(keys, stacked, sig)
-                       if s is not None]
-            placed = [self._pad_and_place(a, s, n) for _, a, s in present]
-            fn = self._compiled(plan, tuple(k for k, _, _ in present),
+            present = self._present(keys, placed, sig)
+            fn = self._compiled(slotted, tuple(k for k, _, _ in present),
                                 tuple(s for _, _, s in present), "count")
-            total += int(fn(*placed))
-        return total
+            parts.append(fn(params, *[a for _, a, _ in present]))
+        return parts
+
+    def count(self, plan, holder, index, shards) -> int:
+        return sum(int(x) for x in self.count_async(
+            plan, holder, index, shards))
 
     def segments(self, plan, holder, index, shards) -> dict[int, jax.Array]:
         from ..core import SHARD_WORDS
 
+        keys = plan_inputs(plan)
+        slotted, params = parametrize(plan)
+        params = jnp.asarray(params)
         out: dict[int, jax.Array] = {}
-        for shard_list, keys, stacked, sig in self._gather_inputs(
-                plan, holder, index, shards):
+        for shard_list, placed, sig in self._placed_groups(
+                keys, holder, index, shards):
             if all(s is None for s in sig):
                 zero = jnp.zeros(SHARD_WORDS, dtype=jnp.uint32)
                 for shard in shard_list:
                     out[shard] = zero
                 continue
-            n = len(shard_list)
-            present = [(k, a, s) for k, a, s in zip(keys, stacked, sig)
-                       if s is not None]
-            placed = [self._pad_and_place(a, s, n) for _, a, s in present]
-            fn = self._compiled(plan, tuple(k for k, _, _ in present),
+            present = self._present(keys, placed, sig)
+            fn = self._compiled(slotted, tuple(k for k, _, _ in present),
                                 tuple(s for _, _, s in present), None)
-            segs = fn(*placed)
+            segs = fn(params, *[a for _, a, _ in present])
             for i, shard in enumerate(shard_list):
                 out[shard] = segs[i]
         return out
+
+    # -- row_counts: TopN/Rows/MinRow/MaxRow (fragment.go:1570 top) --------
+
+    @staticmethod
+    def merge_counts(parts) -> np.ndarray:
+        """Sum per-group count vectors of differing lengths (shape groups
+        have different row capacities)."""
+        acc = np.zeros(0, dtype=np.int64)
+        for p in parts:
+            counts = np.asarray(p, dtype=np.int64)
+            if counts.size > acc.size:
+                counts[: acc.size] += acc
+                acc = counts
+            else:
+                acc[: counts.size] += counts
+        return acc
+
+    def row_counts_async(self, field: str, view: str, filter_plan, holder,
+                         index, shards) -> list:
+        """Dispatch per-row popcounts of (field, view) fragments across all
+        shards, masked by ``filter_plan``'s result when given.  Returns
+        unblocked per-group device vectors; combine with
+        ``merge_counts``."""
+        primary = (field, view)
+        keys = [primary] + [k for k in self._filter_keys(filter_plan)
+                            if k != primary]
+        slotted, params = (None, np.zeros(0, dtype=np.int32)) \
+            if filter_plan is None else parametrize(filter_plan)
+        params = jnp.asarray(params)
+        parts = []
+        for shard_list, placed, sig in self._placed_groups(
+                keys, holder, index, shards):
+            if sig[0] is None:
+                continue  # field fragment absent everywhere in this group
+            present = self._present(keys, placed, sig)
+            placed_args = [a for _, a, _ in present]
+            pkeys = tuple(k for k, _, _ in present)
+            pshapes = tuple(s for _, _, s in present)
+            key = self._plan_key("row_counts", slotted, pkeys, pshapes)
+            fn = self._cache.get(key)
+            if fn is None:
+                fplan = slotted
+
+                def per_shard(params_, *arrays):
+                    frag = arrays[0]               # [rows, W]
+                    if fplan is None:
+                        masked = frag
+                    else:
+                        frags = dict(zip(pkeys, arrays))
+                        seg = eval_plan(fplan, frags, params_)   # [W]
+                        masked = frag & seg[None, :]
+                    return jnp.sum(
+                        jax.lax.population_count(masked).astype(jnp.int32),
+                        axis=-1)                   # [rows]
+
+                def block_fn(params_, *arrays):
+                    counts = jnp.sum(jax.vmap(
+                        per_shard, in_axes=(None,) + (0,) * len(pshapes))(
+                            params_, *arrays), axis=0)
+                    return jax.lax.psum(counts, axis_name=SHARD_AXIS)
+
+                fn = self._jit_shard_map(
+                    key, block_fn,
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
+            parts.append(fn(params, *placed_args))
+        return parts
+
+    def row_counts(self, field: str, view: str, filter_plan, holder,
+                   index, shards) -> np.ndarray:
+        return self.merge_counts(self.row_counts_async(
+            field, view, filter_plan, holder, index, shards))
+
+    # -- BSI aggregations (fragment.go:1111 sum, :1147 min/max) ------------
+
+    def bsi_sum_async(self, field: str, view: str, filter_plan, holder,
+                      index, shards) -> list:
+        """Dispatch the per-slice popcounts; returns unblocked [2, depth+1]
+        device matrices (one per shape group); combine via
+        ``bsi.weighted_sum`` per part and add."""
+        primary = (field, view)
+        keys = [primary] + [k for k in self._filter_keys(filter_plan)
+                            if k != primary]
+        slotted, params = (None, np.zeros(0, dtype=np.int32)) \
+            if filter_plan is None else parametrize(filter_plan)
+        params = jnp.asarray(params)
+        parts = []
+        for shard_list, placed, sig in self._placed_groups(
+                keys, holder, index, shards):
+            if sig[0] is None or sig[0][0] < bsi.OFFSET_ROW + 1:
+                continue
+            present = self._present(keys, placed, sig)
+            placed_args = [a for _, a, _ in present]
+            pkeys = tuple(k for k, _, _ in present)
+            pshapes = tuple(s for _, _, s in present)
+            key = self._plan_key("bsi_sum", slotted, pkeys, pshapes)
+            fn = self._cache.get(key)
+            if fn is None:
+                fplan = slotted
+
+                def per_shard(params_, *arrays):
+                    frag = arrays[0]
+                    filt = None
+                    if fplan is not None:
+                        frags = dict(zip(pkeys, arrays))
+                        filt = eval_plan(fplan, frags, params_)
+                    return bsi.sum_counts(frag, filt)   # [2, depth+1]
+
+                def block_fn(params_, *arrays):
+                    counts = jnp.sum(jax.vmap(
+                        per_shard, in_axes=(None,) + (0,) * len(pshapes))(
+                            params_, *arrays), axis=0)
+                    return jax.lax.psum(counts, axis_name=SHARD_AXIS)
+
+                fn = self._jit_shard_map(
+                    key, block_fn,
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
+            parts.append(fn(params, *placed_args))
+        return parts
+
+    def bsi_sum(self, field: str, view: str, filter_plan, holder,
+                index, shards) -> tuple[int, int]:
+        """(sum-of-base-values, non-null-count) over all shards."""
+        total, count = 0, 0
+        for p in self.bsi_sum_async(field, view, filter_plan, holder,
+                                    index, shards):
+            s, cnt = bsi.weighted_sum(np.asarray(p))
+            total += s
+            count += cnt
+        return total, count
+
+    def bsi_min_max(self, field: str, view: str, filter_plan, holder,
+                    index, shards, want_max: bool):
+        """Per-shard extremum bits gathered to host; returns a list of
+        (value, count) per shard (padded shards yield count 0)."""
+        primary = (field, view)
+        keys = [primary] + [k for k in self._filter_keys(filter_plan)
+                            if k != primary]
+        slotted, params = (None, np.zeros(0, dtype=np.int32)) \
+            if filter_plan is None else parametrize(filter_plan)
+        params = jnp.asarray(params)
+        out = []
+        for shard_list, placed, sig in self._placed_groups(
+                keys, holder, index, shards):
+            if sig[0] is None or sig[0][0] < bsi.OFFSET_ROW + 1:
+                continue
+            present = self._present(keys, placed, sig)
+            placed_args = [a for _, a, _ in present]
+            pkeys = tuple(k for k, _, _ in present)
+            pshapes = tuple(s for _, _, s in present)
+            key = self._plan_key("bsi_minmax", slotted, pkeys, pshapes,
+                                 extra=(want_max,))
+            fn = self._cache.get(key)
+            if fn is None:
+                fplan = slotted
+
+                def per_shard(params_, *arrays):
+                    frag = arrays[0]
+                    filt = None
+                    if fplan is not None:
+                        frags = dict(zip(pkeys, arrays))
+                        filt = eval_plan(fplan, frags, params_)
+                    return bsi.min_max_bits(frag, filt, want_max=want_max)
+
+                def block_fn(params_, *arrays):
+                    return jax.vmap(
+                        per_shard, in_axes=(None,) + (0,) * len(pshapes))(
+                            params_, *arrays)
+
+                fn = self._jit_shard_map(
+                    key, block_fn,
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes),
+                    (P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)))
+            bits, neg, cnt = (np.asarray(x) for x in fn(params, *placed_args))
+            for i in range(len(shard_list)):
+                out.append(bsi.reconstruct_min_max(
+                    bits[i], int(neg[i]), int(cnt[i])))
+        return out
+
+    # -- batched variants: B same-shape calls, ONE executable invocation ---
+    # A multi-call query's same-shape calls (e.g. 64 distinct Counts)
+    # execute as one vmapped computation over a [B, P] params matrix —
+    # collapsing B dispatch round trips into one.  This is the TPU-native
+    # replacement for the reference's worker pool soaking up concurrent
+    # queries (executor.go:80-110).
+
+    def count_batch_async(self, slotted, params_mat, holder, index,
+                          shards) -> list:
+        """B counts that share one plan shape; parts are [B] vectors."""
+        keys = plan_inputs(slotted)
+        params = jnp.asarray(params_mat)               # [B, P]
+        parts = []
+        for shard_list, placed, sig in self._placed_groups(
+                keys, holder, index, shards):
+            if all(s is None for s in sig):
+                continue
+            present = self._present(keys, placed, sig)
+            pkeys = tuple(k for k, _, _ in present)
+            pshapes = tuple(s for _, _, s in present)
+            key = self._plan_key("countB", slotted, pkeys, pshapes)
+            fn = self._cache.get(key)
+            if fn is None:
+                def per_shard(params_, *arrays):
+                    frags = dict(zip(pkeys, arrays))
+                    segs = jax.vmap(
+                        lambda p: eval_plan(slotted, frags, p))(params_)
+                    return jnp.sum(
+                        jax.lax.population_count(segs).astype(jnp.int32),
+                        axis=-1)                       # [B]
+
+                def block_fn(params_, *arrays):
+                    counts = jnp.sum(jax.vmap(
+                        per_shard, in_axes=(None,) + (0,) * len(pshapes))(
+                            params_, *arrays), axis=0)
+                    return jax.lax.psum(counts, axis_name=SHARD_AXIS)
+
+                fn = self._jit_shard_map(
+                    key, block_fn,
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
+            parts.append(fn(params, *[a for _, a, _ in present]))
+        return parts
+
+    def row_counts_batch_async(self, field: str, view: str, slotted_filter,
+                               params_mat, holder, index, shards) -> list:
+        """B row-count passes sharing one filter shape; parts are
+        [B, rows] matrices."""
+        primary = (field, view)
+        keys = [primary] + [k for k in self._filter_keys(slotted_filter)
+                            if k != primary]
+        params = jnp.asarray(params_mat)
+        parts = []
+        for shard_list, placed, sig in self._placed_groups(
+                keys, holder, index, shards):
+            if sig[0] is None:
+                continue
+            present = self._present(keys, placed, sig)
+            pkeys = tuple(k for k, _, _ in present)
+            pshapes = tuple(s for _, _, s in present)
+            key = self._plan_key("row_countsB", slotted_filter, pkeys,
+                                 pshapes)
+            fn = self._cache.get(key)
+            if fn is None:
+                fplan = slotted_filter
+
+                def per_shard(params_, *arrays):
+                    frag = arrays[0]                   # [rows, W]
+                    if fplan is None:
+                        counts = jnp.sum(
+                            jax.lax.population_count(frag).astype(jnp.int32),
+                            axis=-1)                   # [rows]
+                        return jnp.broadcast_to(
+                            counts, (params_.shape[0],) + counts.shape)
+                    frags = dict(zip(pkeys, arrays))
+                    masks = jax.vmap(
+                        lambda p: eval_plan(fplan, frags, p))(params_)
+                    masked = frag[None, :, :] & masks[:, None, :]
+                    return jnp.sum(
+                        jax.lax.population_count(masked).astype(jnp.int32),
+                        axis=-1)                       # [B, rows]
+
+                def block_fn(params_, *arrays):
+                    counts = jnp.sum(jax.vmap(
+                        per_shard, in_axes=(None,) + (0,) * len(pshapes))(
+                            params_, *arrays), axis=0)
+                    return jax.lax.psum(counts, axis_name=SHARD_AXIS)
+
+                fn = self._jit_shard_map(
+                    key, block_fn,
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
+            parts.append(fn(params, *[a for _, a, _ in present]))
+        return parts
+
+    def bsi_sum_batch_async(self, field: str, view: str, slotted_filter,
+                            params_mat, holder, index, shards) -> list:
+        """B BSI sums sharing one filter shape; parts are [B, 2, depth+1]."""
+        primary = (field, view)
+        keys = [primary] + [k for k in self._filter_keys(slotted_filter)
+                            if k != primary]
+        params = jnp.asarray(params_mat)
+        parts = []
+        for shard_list, placed, sig in self._placed_groups(
+                keys, holder, index, shards):
+            if sig[0] is None or sig[0][0] < bsi.OFFSET_ROW + 1:
+                continue
+            present = self._present(keys, placed, sig)
+            pkeys = tuple(k for k, _, _ in present)
+            pshapes = tuple(s for _, _, s in present)
+            key = self._plan_key("bsi_sumB", slotted_filter, pkeys, pshapes)
+            fn = self._cache.get(key)
+            if fn is None:
+                fplan = slotted_filter
+
+                def per_shard(params_, *arrays):
+                    frag = arrays[0]
+                    if fplan is None:
+                        counts = bsi.sum_counts(frag, None)
+                        return jnp.broadcast_to(
+                            counts, (params_.shape[0],) + counts.shape)
+                    frags = dict(zip(pkeys, arrays))
+
+                    def one(p):
+                        return bsi.sum_counts(frag, eval_plan(fplan, frags,
+                                                              p))
+
+                    return jax.vmap(one)(params_)      # [B, 2, depth+1]
+
+                def block_fn(params_, *arrays):
+                    counts = jnp.sum(jax.vmap(
+                        per_shard, in_axes=(None,) + (0,) * len(pshapes))(
+                            params_, *arrays), axis=0)
+                    return jax.lax.psum(counts, axis_name=SHARD_AXIS)
+
+                fn = self._jit_shard_map(
+                    key, block_fn,
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
+            parts.append(fn(params, *[a for _, a, _ in present]))
+        return parts
+
+    # -- GroupBy inner loop (executor.go:1068 executeGroupBy) --------------
+
+    def group_counts(self, last_key: tuple[str, str],
+                     prefix_keys: list[tuple[str, str]],
+                     prefix_rows: list[int], filter_plan, holder,
+                     index, shards) -> np.ndarray:
+        """Per-row popcounts of the last field's fragments masked by the
+        AND of ``prefix_keys[i]``'s row ``prefix_rows[i]`` segments and an
+        optional filter plan, summed over shards.  Prefix row ids are
+        DYNAMIC args — every combo of a GroupBy reuses one executable."""
+        keys = [last_key]
+        for k in prefix_keys:
+            if k not in keys:
+                keys.append(k)
+        for k in self._filter_keys(filter_plan):
+            if k not in keys:
+                keys.append(k)
+        rids = jnp.asarray(prefix_rows, dtype=jnp.int32)
+        slotted, params = (None, np.zeros(0, dtype=np.int32)) \
+            if filter_plan is None else parametrize(filter_plan)
+        params = jnp.asarray(params)
+        parts = []
+        for shard_list, placed, sig in self._placed_groups(
+                keys, holder, index, shards):
+            if sig[0] is None:
+                continue
+            # a missing prefix fragment means the combo row has no bits in
+            # this shard group -> contributes nothing
+            key_to_sig = dict(zip(keys, sig))
+            if any(key_to_sig[k] is None for k in prefix_keys):
+                continue
+            present = self._present(keys, placed, sig)
+            placed_args = [a for _, a, _ in present]
+            pkeys = tuple(k for k, _, _ in present)
+            pshapes = tuple(s for _, _, s in present)
+            key = self._plan_key("group_counts", slotted, pkeys, pshapes,
+                                 extra=(tuple(prefix_keys),))
+            fn = self._cache.get(key)
+            if fn is None:
+                fplan = slotted
+                pk_list = list(prefix_keys)
+
+                def per_shard(rids_, params_, *arrays):
+                    frags = dict(zip(pkeys, arrays))
+                    frag = arrays[0]               # [rows, W]
+                    mask = None
+                    for j, pk in enumerate(pk_list):
+                        pfrag = frags[pk]
+                        # dynamic row index; rows beyond capacity clamp —
+                        # guard with a bounds check so an out-of-range row
+                        # id yields an empty mask, not the last row's bits
+                        rid = rids_[j]
+                        if pfrag.shape[0] == 0:
+                            seg = jnp.zeros(pfrag.shape[-1],
+                                            dtype=pfrag.dtype)
+                        else:
+                            seg = jnp.where(
+                                rid < pfrag.shape[0],
+                                jax.lax.dynamic_index_in_dim(
+                                    pfrag,
+                                    jnp.minimum(rid, pfrag.shape[0] - 1),
+                                    axis=0, keepdims=False),
+                                jnp.zeros_like(pfrag[0]))
+                        mask = seg if mask is None else mask & seg
+                    if fplan is not None:
+                        fseg = eval_plan(fplan, frags, params_)
+                        mask = fseg if mask is None else mask & fseg
+                    masked = frag if mask is None else frag & mask[None, :]
+                    return jnp.sum(
+                        jax.lax.population_count(masked).astype(jnp.int32),
+                        axis=-1)
+
+                def block_fn(rids_, params_, *arrays):
+                    counts = jnp.sum(
+                        jax.vmap(per_shard, in_axes=(None, None) + (0,) * len(
+                            pshapes))(rids_, params_, *arrays), axis=0)
+                    return jax.lax.psum(counts, axis_name=SHARD_AXIS)
+
+                fn = self._jit_shard_map(
+                    key, block_fn,
+                    (P(), P()) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
+            parts.append(fn(rids, params, *placed_args))
+        return self.merge_counts(parts)

@@ -31,6 +31,9 @@ class Config:
     node_id: str = "node0"
     cluster_hosts: list = dataclasses.field(default_factory=list)
     replica_n: int = 1
+    # execution: serve queries through the device-mesh executor (stacked
+    # shard batches + ICI reductions); off = per-shard host dispatch
+    use_mesh: bool = True
     # monitors
     anti_entropy_interval: float = 600.0
     metric_poll_interval: float = 60.0
@@ -56,6 +59,7 @@ class Config:
                 "anti_entropy_interval", float),
             "PILOSA_TPU_VERBOSE": ("verbose", lambda s: s == "true"),
             "PILOSA_TPU_MAX_ROW_ID": ("max_row_id", int),
+            "PILOSA_TPU_USE_MESH": ("use_mesh", lambda s: s != "false"),
         }
         for env, (attr, conv) in env_map.items():
             if env in os.environ:
@@ -77,7 +81,7 @@ class Config:
         cfg = cls()
         mapping = {
             "data-dir": "data_dir", "bind": "bind", "max-op-n": "max_op_n",
-            "max-row-id": "max_row_id",
+            "max-row-id": "max_row_id", "use-mesh": "use_mesh",
         }
         for key, attr in mapping.items():
             if key in doc:
@@ -118,7 +122,8 @@ class Server:
                 # to it with a read-through cache
                 self.holder.translate_factory = \
                     self.cluster.remote_translate_factory
-        self.api = API(self.holder, cluster=self.cluster, stats=self.stats)
+        self.api = API(self.holder, cluster=self.cluster, stats=self.stats,
+                       use_mesh=self.config.use_mesh)
         host, port = self._parse_bind(self.config.bind)
         self.httpd = make_http_server(self.api, host, port, server=self)
         self._threads: list[threading.Thread] = []
