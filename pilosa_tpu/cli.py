@@ -138,6 +138,9 @@ def cmd_export(args) -> int:
 
 def cmd_check(args) -> int:
     """Offline fragment file integrity check (ctl/check.go:28-135)."""
+    import numpy as np
+
+    from .core import SHARD_WORDS
     from .storage.fragment import Fragment
 
     ok = True
@@ -146,7 +149,7 @@ def cmd_check(args) -> int:
             continue
         try:
             frag = Fragment(path, "check", "check", "check", 0)
-            n = int(frag.words.any(axis=1).sum())
+            n = int(np.unique(frag._idx // SHARD_WORDS).size)
             print(f"{path}: OK rows_with_data={n}")
             frag.close()
         except Exception as e:
@@ -159,18 +162,19 @@ def cmd_inspect(args) -> int:
     """Fragment stats (ctl/inspect.go:30-110)."""
     import numpy as np
 
+    from .core import SHARD_WORDS
     from .storage.fragment import Fragment
 
     for path in args.files:
         frag = Fragment(path, "inspect", "inspect", "inspect", 0)
-        words = frag.words
-        n_bits = int(np.bitwise_count(words).sum())
-        rows_used = int(words.any(axis=1).sum())
-        density = n_bits / words.size / 32 if words.size else 0.0
+        n_bits = int(np.bitwise_count(frag._val).sum())
+        rows_used = int(np.unique(frag._idx // SHARD_WORDS).size)
+        total_bits = frag.n_rows * SHARD_WORDS * 32
+        density = n_bits / total_bits if total_bits else 0.0
         print(json.dumps({
-            "path": path, "rows": words.shape[0], "rowsWithData": rows_used,
+            "path": path, "rows": frag.n_rows, "rowsWithData": rows_used,
             "bits": n_bits, "density": round(density, 6),
-            "sizeBytes": words.nbytes,
+            "sizeBytes": frag.host_bytes(),
         }))
         frag.close()
     return 0

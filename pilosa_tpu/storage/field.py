@@ -267,9 +267,7 @@ class Field:
         """Mutex semantics: at most one row per column
         (fragment.go setBit mutex handling / :2106 bulkImportMutex)."""
         changed = False
-        w, bitmask = bitset.word_bit_np(shard_col)
-        col_rows = np.nonzero(frag.words[:, w] & bitmask)[0]
-        for r in col_rows:
+        for r in frag.rows_with_bit(shard_col):
             if int(r) != row:
                 changed |= frag.clear_bit(int(r), shard_col)
         changed |= frag.set_bit(row, shard_col)
@@ -344,16 +342,14 @@ class Field:
         if frag is None:
             return 0, False
         shard_col = col % SHARD_WIDTH
-        w, bit = bitset.word_bit_np(shard_col)
-        colwords = frag.words[:, w]
-        if not (colwords[bsi.EXISTS_ROW] & bit):
+        rows = set(int(r) for r in frag.rows_with_bit(shard_col))
+        if bsi.EXISTS_ROW not in rows:
             return 0, False
-        depth = frag.bit_depth()
         mag = 0
-        for i in range(depth):
-            if colwords[bsi.OFFSET_ROW + i] & bit:
-                mag |= 1 << i
-        if colwords[bsi.SIGN_ROW] & bit:
+        for r in rows:
+            if r >= bsi.OFFSET_ROW:
+                mag |= 1 << (r - bsi.OFFSET_ROW)
+        if bsi.SIGN_ROW in rows:
             mag = -mag
         return mag + self.options.base, True
 

@@ -1,18 +1,36 @@
-"""Fragment: one (field, view, shard) bitmap, host-authoritative with a
-device-resident HBM mirror.
+"""Fragment: one (field, view, shard) bitmap, host-sparse with dense
+device mirrors under an HBM budget.
 
-The reference's fragment (fragment.go:100-159) is an mmap'd roaring file with
-an append-only op log and background snapshot rewrites.  Here the
-authoritative copy is a dense ``uint32[n_rows, SHARD_WORDS]`` numpy array on
-the host; mutations (set/clear/setValue/import) update it immediately and
-append to a write-ahead op log.  The device mirror is uploaded lazily on first
-query after a write burst and stays resident in HBM (the mmap replacement) so
-repeated queries never re-cross PCIe/DCN.  Snapshots rewrite the on-disk file
-and truncate the WAL after ``max_op_n`` ops (fragment.go:84 MaxOpN, :2311
-snapshot).
+The reference's fragment (fragment.go:100-159) is an mmap'd roaring file
+with an append-only op log and background snapshot rewrites.  Here the
+authoritative copy is a SPARSE word store: sorted flat indices
+(``row * SHARD_WORDS + word``) with their non-zero uint32 word values —
+the in-memory form of the snapshot format itself.  Host memory is
+proportional to set bits (a 954-shard index with a few bits per row loads
+in megabytes, where a dense ``[rows, 32768]`` tensor per fragment would
+need terabytes), replacing roaring's array/run containers as the sparsity
+mechanism (roaring/roaring.go:64-69).
 
-Row capacity grows by doubling so device executable shapes change rarely
-(each distinct row count compiles its own XLA plan).
+The device mirror is materialised DENSE (``uint32[cap_rows, SHARD_WORDS]``)
+on first query and stays resident in HBM — dense tiles are what the TPU
+bit-kernels operate on (see core.py).  Mirrors register with a
+DeviceBudget: under a configured limit the least-recently-used mirrors are
+evicted and transparently re-uploaded on next use (the HBM analog of the
+reference's mmap paging + syswrap map caps, syswrap/mmap.go:46).
+
+Container-tile block-sparsity on the DEVICE (uploading only non-empty
+2048-word tiles plus a key table) was considered and deferred: with
+uniformly sparse data every tile is non-empty (a 0.1%-density row still
+touches every container), the roaring array-container win only appears
+under heavy clustering, and tile gather/scatter puts a data-dependent
+indirection on the hot path that XLA cannot fuse.  The budget + eviction
+path bounds worst-case HBM instead; revisit if profiles show clustered
+tiles dominating.
+
+Mutations update the sparse store immediately and append to a write-ahead
+op log; snapshots rewrite the on-disk file and truncate the WAL after
+``max_op_n`` ops (fragment.go:84 MaxOpN, :2311 snapshot).  Row capacity
+grows by doubling so device executable shapes change rarely.
 """
 
 from __future__ import annotations
@@ -32,11 +50,15 @@ from ..core import (
     SHARD_WORDS,
 )
 from ..ops import bitset, bsi
+from .membudget import DEFAULT_BUDGET
 
-# On-disk snapshot format: magic, n_rows, words, nnz then nnz LE
-# (flat_word_index u32, word_value u32) pairs — sparse, so a 20k-bit fragment
-# snapshot is ~tens of KB instead of a dense n_rows*128KB image.
-_MAGIC = b"PTPUFRG2"
+# On-disk snapshot formats.
+# v2 (magic PTPUFRG2): header then nnz LE (flat u32, word u32) interleaved
+# pairs — read-compatible.
+# v3 (magic PTPUFRG3): header then nnz LE u64 flat indices, then nnz LE u32
+# words — supports tall sparse fragments whose flat index exceeds u32.
+_MAGIC_V2 = b"PTPUFRG2"
+_MAGIC_V3 = b"PTPUFRG3"
 _HEADER = struct.Struct("<8sIIQ")
 
 # WAL record: op(u8) row(i64) col(i64)  (roaring.go:4359 opType add/remove;
@@ -47,12 +69,41 @@ _OP_SET, _OP_CLEAR = 0, 1
 _MIN_ROWS = 4
 
 
+def _pairs_to_words(rows: np.ndarray, cols: np.ndarray):
+    """Aggregate (row, col) bit pairs into unique sorted flat word indices
+    + OR-combined word values."""
+    flat = rows.astype(np.int64) * SHARD_WORDS + (cols >> 5)
+    bit = (np.uint32(1) << (cols & 31).astype(np.uint32))
+    uniq, inv = np.unique(flat, return_inverse=True)
+    out = np.zeros(uniq.size, dtype=np.uint32)
+    np.bitwise_or.at(out, inv, bit)
+    return uniq, out
+
+
+def _expand_words(idx: np.ndarray, val: np.ndarray):
+    """Inverse of _pairs_to_words: (rows, shard-local cols) of every set
+    bit, ordered by (row, col)."""
+    rows_out, cols_out = [], []
+    for b in range(32):
+        sel = (val >> np.uint32(b)) & np.uint32(1) > 0
+        if sel.any():
+            f = idx[sel]
+            rows_out.append(f // SHARD_WORDS)
+            cols_out.append((f % SHARD_WORDS) * 32 + b)
+    if not rows_out:
+        return (np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64))
+    rows = np.concatenate(rows_out)
+    cols = np.concatenate(cols_out)
+    order = np.lexsort((cols, rows))
+    return rows[order], cols[order]
+
+
 class Fragment:
     """One (index, field, view, shard) bitmap."""
 
     def __init__(self, path: str | None, index: str, field: str, view: str,
                  shard: int, max_op_n: int = DEFAULT_FRAGMENT_MAX_OP_N,
-                 row_id_cap: int | None = None):
+                 row_id_cap: int | None = None, budget=None):
         self.path = path  # None = purely in-memory (tests)
         self.index = index
         self.field = field
@@ -65,8 +116,12 @@ class Fragment:
         # servers in one process keep independent caps.
         if row_id_cap is not None:
             self.row_id_cap = row_id_cap
+        self.budget = budget if budget is not None else DEFAULT_BUDGET
 
-        self.words = np.zeros((0, SHARD_WORDS), dtype=np.uint32)
+        # sparse word store: sorted flat indices + non-zero word values
+        self._idx = np.zeros(0, dtype=np.int64)
+        self._val = np.zeros(0, dtype=np.uint32)
+        self._cap_rows = 0        # device-shape row capacity (pow2 growth)
         self._mirrors = {}        # device -> cached jax.Array mirror
         self._device_dirty = True
         self._op_n = 0
@@ -79,9 +134,6 @@ class Fragment:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def _snapshot_path(self) -> str:
-        return self.path
-
     def _wal_path(self) -> str:
         return self.path + ".wal"
 
@@ -92,44 +144,70 @@ class Fragment:
             with open(self.path, "rb") as f:
                 magic, n_rows, words, nnz = _HEADER.unpack(
                     f.read(_HEADER.size))
-                if magic != _MAGIC:
-                    raise ValueError(f"bad fragment file magic in {self.path}")
-                pairs = np.fromfile(f, dtype="<u4", count=2 * nnz)
-            if words != SHARD_WORDS:
-                raise ValueError(
-                    f"fragment file {self.path} has {words} words/row, "
-                    f"expected {SHARD_WORDS}")
-            # Row capacity doubles, so a legitimately-written snapshot never
-            # declares more than 2*(cap+1) rows; beyond that the header is
-            # corrupt or was written under a larger max_row_id config — an
-            # explicit error either way, instead of a terabyte np.zeros.
-            if n_rows > 2 * (self.row_id_cap + 1):
-                raise ValueError(
-                    f"fragment file {self.path} declares {n_rows} rows, "
-                    f"above the configured max_row_id {self.row_id_cap}; "
-                    f"raise max_row_id if this data was written with a "
-                    f"larger cap")
-            self.words = np.zeros((n_rows, words), dtype=np.uint32)
-            if nnz:
-                flat = self.words.reshape(-1)
-                flat[pairs[0::2].astype(np.int64)] = pairs[1::2]
+                if magic not in (_MAGIC_V2, _MAGIC_V3):
+                    raise ValueError(
+                        f"bad fragment file magic in {self.path}")
+                if words != SHARD_WORDS:
+                    raise ValueError(
+                        f"fragment file {self.path} has {words} words/row, "
+                        f"expected {SHARD_WORDS}")
+                # Row capacity doubles, so a legitimately-written snapshot
+                # never declares more than 2*(cap+1) rows; beyond that the
+                # header is corrupt or was written under a larger
+                # max_row_id config.
+                if n_rows > 2 * (self.row_id_cap + 1):
+                    raise ValueError(
+                        f"fragment file {self.path} declares {n_rows} rows, "
+                        f"above the configured max_row_id "
+                        f"{self.row_id_cap}; raise max_row_id if this data "
+                        f"was written with a larger cap")
+                if magic == _MAGIC_V2:
+                    pairs = np.fromfile(f, dtype="<u4", count=2 * nnz)
+                    self._idx = pairs[0::2].astype(np.int64)
+                    self._val = pairs[1::2].astype(np.uint32)
+                else:
+                    self._idx = np.fromfile(f, dtype="<u8",
+                                            count=nnz).astype(np.int64)
+                    self._val = np.fromfile(f, dtype="<u4", count=nnz)
+            keep = self._val != 0
+            if not keep.all():
+                self._idx, self._val = self._idx[keep], self._val[keep]
+            self._cap_rows = n_rows
         if os.path.exists(self._wal_path()):
             with open(self._wal_path(), "rb") as f:
                 buf = f.read()
-            for off in range(0, len(buf) - len(buf) % _OP.size, _OP.size):
-                op, row, col = _OP.unpack_from(buf, off)
-                try:
-                    if op == _OP_SET:
-                        self._set_bit_mem(row, col)
-                    else:
-                        self._clear_bit_mem(row, col)
-                except ValueError as e:
-                    raise ValueError(
-                        f"replaying WAL {self._wal_path()}: {e}; raise "
-                        f"max_row_id if this data was written with a larger "
-                        f"cap") from e
+            self._replay_wal(buf)
             self._op_n = len(buf) // _OP.size
         self._wal_file = open(self._wal_path(), "ab", buffering=0)
+
+    def _replay_wal(self, buf: bytes):
+        """Apply WAL records in order, batching consecutive same-op runs."""
+        n = len(buf) - len(buf) % _OP.size
+        run_op, run_rows, run_cols = None, [], []
+
+        def flush():
+            nonlocal run_rows, run_cols
+            if not run_rows:
+                return
+            rows = np.asarray(run_rows, dtype=np.int64)
+            cols = np.asarray(run_cols, dtype=np.int64)
+            try:
+                self._apply_bits(rows, cols, clear=(run_op == _OP_CLEAR))
+            except ValueError as e:
+                raise ValueError(
+                    f"replaying WAL {self._wal_path()}: {e}; raise "
+                    f"max_row_id if this data was written with a larger "
+                    f"cap") from e
+            run_rows, run_cols = [], []
+
+        for off in range(0, n, _OP.size):
+            op, row, col = _OP.unpack_from(buf, off)
+            if op != run_op:
+                flush()
+                run_op = op
+            run_rows.append(row)
+            run_cols.append(col)
+        flush()
 
     def close(self):
         with self._lock:
@@ -138,7 +216,7 @@ class Fragment:
                     self.snapshot()
                 self._wal_file.close()
                 self._wal_file = None
-            self._mirrors.clear()
+            self._drop_mirrors()
 
     def snapshot(self):
         """Rewrite the snapshot file and truncate the WAL
@@ -149,16 +227,10 @@ class Fragment:
                 return
             tmp = self.path + ".snapshotting"
             with open(tmp, "wb") as f:
-                n_rows, words = self.words.shape
-                flat = self.words.reshape(-1)
-                idx = np.nonzero(flat)[0]
-                if idx.size and int(idx[-1]) >> 32:
-                    raise ValueError("fragment too large for u32 flat index")
-                f.write(_HEADER.pack(_MAGIC, n_rows, words, idx.size))
-                pairs = np.empty(2 * idx.size, dtype="<u4")
-                pairs[0::2] = idx.astype(np.uint32)
-                pairs[1::2] = flat[idx]
-                pairs.tofile(f)
+                f.write(_HEADER.pack(_MAGIC_V3, self._cap_rows, SHARD_WORDS,
+                                     self._idx.size))
+                self._idx.astype("<u8").tofile(f)
+                self._val.astype("<u4").tofile(f)
             os.replace(tmp, self.path)
             self._dirty_data = False
             if self._wal_file is not None:
@@ -170,55 +242,127 @@ class Fragment:
 
     @property
     def n_rows(self) -> int:
-        return self.words.shape[0]
+        """Device-shape row capacity (doubling growth)."""
+        return self._cap_rows
 
     def max_row_id(self) -> int:
         """Highest row with any bit set (fragment.go maxRow)."""
-        nz = np.nonzero(self.words.any(axis=1))[0]
-        return int(nz[-1]) if nz.size else 0
+        return int(self._idx[-1] // SHARD_WORDS) if self._idx.size else 0
+
+    def host_bytes(self) -> int:
+        """Host memory held by the sparse store."""
+        return int(self._idx.nbytes + self._val.nbytes)
 
     # Default cap when none is threaded in (class fallback keeps in-memory
     # test fragments working without plumbing).
     row_id_cap = DEFAULT_MAX_ROW_ID
 
     def _ensure_rows(self, row_id: int):
-        if row_id < self.n_rows:
+        if row_id < self._cap_rows:
             return
         if row_id > self.row_id_cap:
             raise ValueError(
                 f"row id {row_id} exceeds the configured maximum "
                 f"{self.row_id_cap} (max_row_id)")
-        new_rows = max(_MIN_ROWS, self.n_rows)
+        new_rows = max(_MIN_ROWS, self._cap_rows)
         while new_rows <= row_id:
             new_rows *= 2
-        grown = np.zeros((new_rows, SHARD_WORDS), dtype=np.uint32)
-        grown[: self.n_rows] = self.words
-        self.words = grown
-        self._mirrors.clear()
+        self._cap_rows = new_rows
+        self._mark_device_dirty()
+
+    def _mark_device_dirty(self):
         self._device_dirty = True
+        self._dirty_data = True
+
+    # -- sparse store primitives -------------------------------------------
+
+    def _locate(self, nidx: np.ndarray):
+        """(positions, exists-mask) of nidx in the store."""
+        pos = np.searchsorted(self._idx, nidx)
+        if self._idx.size:
+            exists = (pos < self._idx.size) & \
+                (self._idx[np.minimum(pos, self._idx.size - 1)] == nidx)
+        else:
+            exists = np.zeros(nidx.shape, dtype=bool)
+        return pos, exists
+
+    def _or_words(self, nidx: np.ndarray, nval: np.ndarray) -> int:
+        """OR word values into the store; returns changed-bit count."""
+        pos, exists = self._locate(nidx)
+        changed = 0
+        upd = pos[exists]
+        if upd.size:
+            old = self._val[upd]
+            new = old | nval[exists]
+            changed += int(np.bitwise_count(new & ~old).sum())
+            self._val[upd] = new
+        ins = ~exists
+        if ins.any():
+            changed += int(np.bitwise_count(nval[ins]).sum())
+            self._idx = np.insert(self._idx, pos[ins], nidx[ins])
+            self._val = np.insert(self._val, pos[ins], nval[ins])
+        return changed
+
+    def _andnot_words(self, nidx: np.ndarray, nval: np.ndarray) -> int:
+        """Clear word bits; returns changed-bit count."""
+        pos, exists = self._locate(nidx)
+        upd = pos[exists]
+        if not upd.size:
+            return 0
+        old = self._val[upd]
+        new = old & ~nval[exists]
+        changed = int(np.bitwise_count(old & ~new).sum())
+        if changed:
+            self._val[upd] = new
+            keep = self._val != 0
+            if not keep.all():
+                self._idx, self._val = self._idx[keep], self._val[keep]
+        return changed
+
+    def _apply_bits(self, rows, cols, clear: bool) -> int:
+        if rows.size == 0:
+            return 0
+        self._ensure_rows(int(rows.max()))
+        nidx, nval = _pairs_to_words(rows, cols)
+        n = self._andnot_words(nidx, nval) if clear \
+            else self._or_words(nidx, nval)
+        if n:
+            self._mark_device_dirty()
+        return n
+
+    def _delete_range(self, lo: int, hi: int):
+        """Remove stored words with lo <= flat < hi."""
+        a = np.searchsorted(self._idx, lo)
+        b = np.searchsorted(self._idx, hi)
+        if b > a:
+            self._idx = np.delete(self._idx, slice(a, b))
+            self._val = np.delete(self._val, slice(a, b))
+
+    def _column_mask_clear(self, cols: np.ndarray, max_row=None) -> int:
+        """AND-out the given shard-local columns' bits from every stored
+        word (optionally only rows < max_row); returns changed bits."""
+        if self._idx.size == 0 or cols.size == 0:
+            return 0
+        w, bit = bitset.word_bit_np(cols)
+        mask = np.zeros(SHARD_WORDS, dtype=np.uint32)
+        np.bitwise_or.at(mask, w, bit)
+        w_of = (self._idx % SHARD_WORDS).astype(np.int64)
+        sel = mask[w_of] != 0
+        if max_row is not None:
+            sel &= (self._idx // SHARD_WORDS) < max_row
+        if not sel.any():
+            return 0
+        old = self._val[sel]
+        new = old & ~mask[w_of[sel]]
+        changed = int(np.bitwise_count(old & ~new).sum())
+        if changed:
+            self._val[sel] = new
+            keep = self._val != 0
+            if not keep.all():
+                self._idx, self._val = self._idx[keep], self._val[keep]
+        return changed
 
     # -- mutation ----------------------------------------------------------
-
-    def _set_bit_mem(self, row: int, col: int) -> bool:
-        self._ensure_rows(row)
-        w, bit = bitset.word_bit_np(col)
-        changed = not (self.words[row, w] & bit)
-        if changed:
-            self.words[row, w] |= bit
-            self._device_dirty = True
-            self._dirty_data = True
-        return changed
-
-    def _clear_bit_mem(self, row: int, col: int) -> bool:
-        if row >= self.n_rows:
-            return False
-        w, bit = bitset.word_bit_np(col)
-        changed = bool(self.words[row, w] & bit)
-        if changed:
-            self.words[row, w] &= ~bit
-            self._device_dirty = True
-            self._dirty_data = True
-        return changed
 
     def _log_op(self, op: int, row: int, col: int):
         if self._wal_file is not None:
@@ -233,14 +377,18 @@ class Fragment:
         """Set one bit; col is shard-local.  Returns True if changed
         (fragment.go:647 setBit)."""
         with self._lock:
-            changed = self._set_bit_mem(row, col)
+            changed = self._apply_bits(np.asarray([row], dtype=np.int64),
+                                       np.asarray([col], dtype=np.int64),
+                                       clear=False) > 0
             if changed:
                 self._log_op(_OP_SET, row, col)
             return changed
 
     def clear_bit(self, row: int, col: int) -> bool:
         with self._lock:
-            changed = self._clear_bit_mem(row, col)
+            changed = self._apply_bits(np.asarray([row], dtype=np.int64),
+                                       np.asarray([col], dtype=np.int64),
+                                       clear=True) > 0
             if changed:
                 self._log_op(_OP_CLEAR, row, col)
             return changed
@@ -255,26 +403,8 @@ class Fragment:
         if rows.size == 0:
             return 0
         with self._lock:
-            self._ensure_rows(int(rows.max()))
-            w, bit = bitset.word_bit_np(cols)
-            # Only touched rows participate; avoids streaming the whole
-            # fragment for small imports.
-            urows = np.unique(rows)
-            delta = np.zeros((urows.size, self.words.shape[1]),
-                             dtype=np.uint32)
-            rpos = np.searchsorted(urows, rows)
-            np.bitwise_or.at(delta, (rpos, w), bit)
-            target = self.words[urows]
-            if clear:
-                changed_words = target & delta
-                self.words[urows] = target & ~delta
-            else:
-                changed_words = ~target & delta
-                self.words[urows] = target | delta
-            n_changed = int(np.bitwise_count(changed_words).sum())
+            n_changed = self._apply_bits(rows, cols, clear=clear)
             if n_changed:
-                self._device_dirty = True
-                self._dirty_data = True
                 op = _OP_CLEAR if clear else _OP_SET
                 if self._wal_file is not None:
                     recs = b"".join(
@@ -302,38 +432,33 @@ class Fragment:
         urow = np.fromiter(last.values(), dtype=np.int64, count=len(last))
         with self._lock:
             self._ensure_rows(int(urow.max()))
-            w, bit = bitset.word_bit_np(ucols)
-            colmask = np.zeros(self.words.shape[1], dtype=np.uint32)
-            np.bitwise_or.at(colmask, w, bit)
-            before = int(np.bitwise_count(self.words & colmask).sum())
-            pre_winner = int(np.count_nonzero(self.words[urow, w] & bit))
-            # clear every row's bits at the target columns, then set winners
-            self.words &= ~colmask
-            np.bitwise_or.at(self.words, (urow, w), bit)
-            # changed = bits cleared off losers + winner bits newly set
-            n_changed = (before - pre_winner) + (ucols.size - pre_winner)
-            self._device_dirty = True
-            self._dirty_data = True
+            cleared = self._column_mask_clear(ucols)
+            set_changed = self._apply_bits(urow, ucols, clear=False)
+            n_changed = cleared + set_changed
+            if n_changed:
+                self._mark_device_dirty()
             if self._wal_file is not None:
                 self.snapshot()
-            return max(n_changed, 0)
+            return n_changed
 
     def set_row(self, row: int, seg: np.ndarray | None):
         """Replace an entire row's bits (Store/SetRow, fragment.go setRow)."""
         with self._lock:
             self._ensure_rows(row)
-            if seg is None:
-                self.words[row] = 0
-            else:
-                self.words[row] = np.asarray(seg, dtype=np.uint32)
-            self._device_dirty = True
-            self._dirty_data = True
+            base = row * SHARD_WORDS
+            self._delete_range(base, base + SHARD_WORDS)
+            if seg is not None:
+                seg = np.asarray(seg, dtype=np.uint32)
+                nz = np.nonzero(seg)[0]
+                if nz.size:
+                    self._or_words(base + nz.astype(np.int64), seg[nz])
+            self._mark_device_dirty()
             self.snapshot()  # row stores bypass the op log
 
     # -- BSI mutation (int fields) ----------------------------------------
 
     def bit_depth(self) -> int:
-        return max(0, self.n_rows - bsi.OFFSET_ROW)
+        return max(0, self._cap_rows - bsi.OFFSET_ROW)
 
     def set_value(self, col: int, bit_depth: int, value: int) -> bool:
         """Set a column's integer value (fragment.go:977 setValueBase).
@@ -343,23 +468,26 @@ class Fragment:
         with self._lock:
             self._ensure_rows(bsi.OFFSET_ROW + bit_depth - 1)
             mag = abs(value)
-            ops: list[tuple[int, int]] = []
+            set_rows, clear_rows = [bsi.EXISTS_ROW], []
             for i in range(bit_depth):
                 row = bsi.OFFSET_ROW + i
-                want = (mag >> i) & 1
-                ops.append((_OP_SET if want else _OP_CLEAR, row))
-            ops.append((_OP_SET if value < 0 else _OP_CLEAR, bsi.SIGN_ROW))
-            ops.append((_OP_SET, bsi.EXISTS_ROW))
+                (set_rows if (mag >> i) & 1 else clear_rows).append(row)
+            (set_rows if value < 0 else clear_rows).append(bsi.SIGN_ROW)
             changed = False
-            for op, row in ops:
-                if op == _OP_SET:
-                    if self._set_bit_mem(row, col):
-                        self._log_op(_OP_SET, row, col)
-                        changed = True
-                else:
-                    if self._clear_bit_mem(row, col):
-                        self._log_op(_OP_CLEAR, row, col)
-                        changed = True
+            col_arr = np.asarray([col] * len(set_rows), dtype=np.int64)
+            before = self._apply_bits(
+                np.asarray(set_rows, dtype=np.int64), col_arr, clear=False)
+            for row in set_rows:
+                if before:  # log all; idempotent on replay
+                    self._log_op(_OP_SET, row, col)
+            changed |= before > 0
+            col_arr = np.asarray([col] * len(clear_rows), dtype=np.int64)
+            cleared = self._apply_bits(
+                np.asarray(clear_rows, dtype=np.int64), col_arr, clear=True)
+            for row in clear_rows:
+                if cleared:
+                    self._log_op(_OP_CLEAR, row, col)
+            changed |= cleared > 0
             return changed
 
     def import_values(self, cols: np.ndarray, values: np.ndarray,
@@ -369,16 +497,15 @@ class Fragment:
         values = np.asarray(values, dtype=np.int64)
         with self._lock:
             self._ensure_rows(bsi.OFFSET_ROW + bit_depth - 1)
-            w, bit = bitset.word_bit_np(cols)
             # clear all target columns' bits first (stale values)
-            mask = np.zeros(SHARD_WORDS, dtype=np.uint32)
-            np.bitwise_or.at(mask, w, bit)
-            self.words[: bsi.OFFSET_ROW + bit_depth] &= ~mask
+            self._column_mask_clear(cols, max_row=bsi.OFFSET_ROW + bit_depth)
             packed = bsi.pack_values(cols, values, depth=bit_depth,
                                      words=SHARD_WORDS)
-            self.words[: packed.shape[0]] |= packed
-            self._device_dirty = True
-            self._dirty_data = True
+            flat = packed.reshape(-1)
+            nz = np.nonzero(flat)[0]
+            if nz.size:
+                self._or_words(nz.astype(np.int64), flat[nz])
+            self._mark_device_dirty()
             self.snapshot()
 
     def clear_values(self, cols: np.ndarray) -> None:
@@ -386,15 +513,11 @@ class Fragment:
         the clear half of importValue (fragment.go:2205 importValue with
         clear)."""
         cols = np.asarray(cols, dtype=np.int64)
-        if cols.size == 0 or self.n_rows == 0:
+        if cols.size == 0 or self._idx.size == 0:
             return
         with self._lock:
-            w, bit = bitset.word_bit_np(cols)
-            mask = np.zeros(SHARD_WORDS, dtype=np.uint32)
-            np.bitwise_or.at(mask, w, bit)
-            self.words &= ~mask
-            self._device_dirty = True
-            self._dirty_data = True
+            if self._column_mask_clear(cols):
+                self._mark_device_dirty()
             self.snapshot()
 
     # -- reads -------------------------------------------------------------
@@ -402,17 +525,55 @@ class Fragment:
     def row(self, row_id: int) -> np.ndarray:
         """Host copy of one row's segment (fragment.go:602 row)."""
         with self._lock:
-            if row_id >= self.n_rows:
-                return np.zeros(SHARD_WORDS, dtype=np.uint32)
-            return self.words[row_id].copy()
+            out = np.zeros(SHARD_WORDS, dtype=np.uint32)
+            if row_id >= self._cap_rows:
+                return out
+            base = row_id * SHARD_WORDS
+            a = np.searchsorted(self._idx, base)
+            b = np.searchsorted(self._idx, base + SHARD_WORDS)
+            if b > a:
+                out[self._idx[a:b] - base] = self._val[a:b]
+            return out
 
     def row_columns(self, row_id: int) -> np.ndarray:
         return bitset.unpack_columns(self.row(row_id))
 
+    def rows_with_bit(self, col: int) -> np.ndarray:
+        """Sorted row ids whose bit at shard-local ``col`` is set (the
+        column read under mutex/bool semantics and BSI value())."""
+        with self._lock:
+            if self._idx.size == 0:
+                return np.zeros(0, dtype=np.int64)
+            w = col >> 5
+            bit = np.uint32(1 << (col & 31))
+            sel = (self._idx % SHARD_WORDS == w) & (self._val & bit > 0)
+            return (self._idx[sel] // SHARD_WORDS).astype(np.int64)
+
+    def pairs(self) -> tuple[np.ndarray, np.ndarray]:
+        """(rows, shard-local cols) of every set bit, (row, col)-ordered —
+        the export/iteration surface (fragment.go:2771 rowIterator)."""
+        with self._lock:
+            return _expand_words(self._idx, self._val)
+
+    def to_dense(self) -> np.ndarray:
+        """Materialise the dense [cap_rows, SHARD_WORDS] tensor (device
+        upload + compatibility paths).  O(cap_rows x 128KB) — transient."""
+        with self._lock:
+            out = np.zeros((self._cap_rows, SHARD_WORDS), dtype=np.uint32)
+            if self._idx.size:
+                out.reshape(-1)[self._idx] = self._val
+            return out
+
+    @property
+    def words(self) -> np.ndarray:
+        """Dense view for compatibility/oracle paths; materialises on each
+        access — do not use on hot paths."""
+        return self.to_dense()
+
     def device(self, target=None):
-        """The HBM-resident mirror (uploads if stale).  This is the query hot
-        path's input — equivalent to the mmap'd storage the reference queries
-        against (fragment.go:311).
+        """The HBM-resident mirror (uploads if stale).  This is the query
+        hot path's input — equivalent to the mmap'd storage the reference
+        queries against (fragment.go:311).
 
         ``target``: an optional jax Device to place the mirror on.  Mesh
         executors pass a device from their own mesh when the mesh's platform
@@ -421,18 +582,38 @@ class Fragment:
         UNCOMMITTED (and is its own cache key) so results can combine freely
         with mesh-sharded arrays — callers on the default platform should
         pass None to share this entry rather than duplicating the upload
-        under a concrete-device key."""
+        under a concrete-device key.
+
+        Every mirror registers with the fragment's DeviceBudget; under a
+        configured limit the LRU mirror is dropped and re-uploaded on next
+        use."""
         import jax
 
         with self._lock:
             if self._device_dirty:
-                self._mirrors.clear()
+                self._drop_mirrors()
                 self._device_dirty = False
             mirror = self._mirrors.get(target)
+            key = (id(self), target)
             if mirror is None:
-                mirror = jax.device_put(self.words, target)
+                mirror = jax.device_put(self.to_dense(), target)
                 self._mirrors[target] = mirror
+                self.budget.register(
+                    key, self._cap_rows * SHARD_WORDS * 4,
+                    lambda t=target: self._evict_mirror(t))
+            else:
+                self.budget.touch(key)
             return mirror
+
+    def _evict_mirror(self, target):
+        # budget eviction callback: drop our reference only (in-flight
+        # computations keep theirs)
+        self._mirrors.pop(target, None)
+
+    def _drop_mirrors(self):
+        for target in list(self._mirrors):
+            self.budget.unregister((id(self), target))
+        self._mirrors.clear()
 
     # -- anti-entropy block checksums (fragment.go:1778 Blocks) ------------
 
@@ -440,25 +621,32 @@ class Fragment:
         """Checksum per HASH_BLOCK_SIZE-row block of non-empty rows."""
         out = {}
         with self._lock:
-            for start in range(0, self.n_rows, HASH_BLOCK_SIZE):
-                blk = self.words[start:start + HASH_BLOCK_SIZE]
-                if not blk.any():
-                    continue
-                if blk.shape[0] < HASH_BLOCK_SIZE:
-                    # pad so the digest depends only on logical content, not
-                    # on the doubling-based row capacity
-                    pad = np.zeros(
-                        (HASH_BLOCK_SIZE - blk.shape[0], blk.shape[1]),
-                        dtype=np.uint32)
-                    blk = np.concatenate([blk, pad])
-                out[start // HASH_BLOCK_SIZE] = hashlib.blake2b(
+            if self._idx.size == 0:
+                return out
+            block_of = self._idx // (HASH_BLOCK_SIZE * SHARD_WORDS)
+            for blk_id in np.unique(block_of):
+                blk = self._dense_block(int(blk_id))
+                out[int(blk_id)] = hashlib.blake2b(
                     blk.tobytes(), digest_size=16).digest()
         return out
 
+    def _dense_block(self, block_id: int) -> np.ndarray:
+        """Dense HASH_BLOCK_SIZE-row block (padded, digest-stable)."""
+        base = block_id * HASH_BLOCK_SIZE * SHARD_WORDS
+        a = np.searchsorted(self._idx, base)
+        b = np.searchsorted(self._idx, base + HASH_BLOCK_SIZE * SHARD_WORDS)
+        blk = np.zeros((HASH_BLOCK_SIZE, SHARD_WORDS), dtype=np.uint32)
+        if b > a:
+            blk.reshape(-1)[self._idx[a:b] - base] = self._val[a:b]
+        return blk
+
     def block_data(self, block_id: int) -> tuple[np.ndarray, np.ndarray]:
         """(rows, cols) pairs of one block (fragment.go:1859 blockData)."""
-        start = block_id * HASH_BLOCK_SIZE
         with self._lock:
-            blk = self.words[start:start + HASH_BLOCK_SIZE]
-            r, c = bitset.unpack_fragment(blk)
+            start = block_id * HASH_BLOCK_SIZE
+            base = start * SHARD_WORDS
+            a = np.searchsorted(self._idx, base)
+            b = np.searchsorted(self._idx,
+                                base + HASH_BLOCK_SIZE * SHARD_WORDS)
+            r, c = _expand_words(self._idx[a:b] - base, self._val[a:b])
             return r + start, c

@@ -1,0 +1,77 @@
+"""Device-memory budget: LRU accounting of device-resident bytes.
+
+The reference's memory story is mmap + the OS page cache (fragments are
+lazily paged, syswrap caps map counts — syswrap/mmap.go:46, fragment.go:311).
+On TPU the equivalent scarce resource is HBM: every fragment queried gets a
+dense device mirror, and mesh execution additionally keeps stacked shard
+blocks resident.  This registry tracks those allocations against a
+configurable budget and evicts the least-recently-used entries (dropping
+the owner's reference so the buffer frees) when a new allocation would
+exceed it.
+
+One process-wide default budget keeps wiring simple (Server config
+``device_budget_mb`` / PILOSA_TPU_DEVICE_BUDGET_MB sets it); tests construct
+private instances.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable
+
+
+class DeviceBudget:
+    def __init__(self, limit_bytes: int | None = None):
+        self.limit_bytes = limit_bytes  # None = unlimited (accounting only)
+        self._entries: OrderedDict[tuple, tuple[int, Callable[[], None]]] = \
+            OrderedDict()
+        self._total = 0
+        self._lock = threading.RLock()
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._total
+
+    def register(self, key: tuple, nbytes: int, evict: Callable[[], None]):
+        """Account ``nbytes`` under ``key``; ``evict`` drops the owner's
+        reference when called.  Evicts LRU entries first if needed."""
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._total -= old[0]
+            if self.limit_bytes is not None:
+                # evict until the new entry fits (never evicting itself)
+                while self._entries and \
+                        self._total + nbytes > self.limit_bytes:
+                    _, (freed, cb) = self._entries.popitem(last=False)
+                    self._total -= freed
+                    try:
+                        cb()
+                    except Exception:
+                        pass
+            self._entries[key] = (nbytes, evict)
+            self._total += nbytes
+
+    def touch(self, key: tuple):
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+
+    def unregister(self, key: tuple):
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is not None:
+                self._total -= e[0]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "residentBytes": self._total,
+                "limitBytes": self.limit_bytes,
+                "entries": len(self._entries),
+            }
+
+
+# Process-wide default (accounting-only until a limit is configured).
+DEFAULT_BUDGET = DeviceBudget()
