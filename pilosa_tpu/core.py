@@ -65,6 +65,23 @@ VIEW_BSI_GROUP_PREFIX = "bsig_"
 DEFAULT_PARTITION_N = 256
 
 
+# Process-wide schema generation counter.  Bumped on any DDL (index/field
+# create or delete) and on BSI bit-depth growth; the prepared-statement cache
+# (executor/prepared.py) keys its entries to it so a resolved plan is never
+# replayed against a changed schema.  Over-invalidation (one counter for all
+# holders) only costs a re-prepare.
+_schema_epoch = 0
+
+
+def bump_schema_epoch():
+    global _schema_epoch
+    _schema_epoch += 1
+
+
+def schema_epoch() -> int:
+    return _schema_epoch
+
+
 _NAME_RE = re.compile(r"[a-z][a-z0-9_-]*")
 
 

@@ -95,22 +95,56 @@ class Slot:
         return f"${self.idx}:{self.sign}:{self.width}"
 
 
-def parametrize(plan):
+def parametrize(plan, trace: bool = False):
     """Replace literal row ids / BSI values with Slots; returns
     (slotted_plan, params int32[P]).  repr(slotted_plan) is the shape cache
-    key; params ride as a runtime argument."""
+    key; params ride as a runtime argument.
+
+    With ``trace=True`` returns (slotted, params, prov, guards) for the
+    prepared-statement cache: ``prov[j]`` describes how params[j] derives
+    from a query-string literal — ``(lit, add, neg, shift, mask)`` meaning
+    ``((±(values[lit]+add)) >> shift) & mask`` — or None for a constant;
+    ``guards`` are (lit, lo, hi) interval constraints on the raw literal
+    values under which this derivation stays valid (sign regions, row-id
+    bounds)."""
+    from ..pql.ast import LitInt
+
     params: list[int] = []
+    prov: list = []
+    guards: list[tuple[int, int, int]] = []
+    LO, HI = -(1 << 62), (1 << 62)
 
     def slot_row(row_id: int) -> Slot:
         s = Slot(len(params))
         params.append(int(row_id))
+        if isinstance(row_id, LitInt):
+            prov.append((row_id.lit, row_id.add, 0, 0, (1 << 31) - 1))
+            # v + add must be a valid non-negative int32 row id
+            guards.append((row_id.lit, -row_id.add,
+                           (1 << 31) - 1 - row_id.add))
+        else:
+            prov.append(None)
         return s
 
     def slot_value(value: int) -> Slot:
         sign = "zero" if value == 0 else ("pos" if value > 0 else "neg")
         s = Slot(len(params), sign, bsi.MAG_BITS)
         mag = abs(int(value))
-        params.extend((mag >> i) & 1 for i in range(bsi.MAG_BITS))
+        tagged = isinstance(value, LitInt)
+        if tagged:
+            # pin the sign region: it selects the compiled code path
+            if sign == "pos":
+                guards.append((value.lit, 1 - value.add, HI - value.add))
+            elif sign == "neg":
+                guards.append((value.lit, LO - value.add, -1 - value.add))
+            else:
+                guards.append((value.lit, -value.add, -value.add))
+        for i in range(bsi.MAG_BITS):
+            params.append((mag >> i) & 1)
+            # the zero path never reads the magnitude bits (and its guard is
+            # exact equality), so they stay constant zeros
+            prov.append((value.lit, value.add, int(value < 0), i, 1)
+                        if tagged and sign != "zero" else None)
         return s
 
     def walk(p):
@@ -131,21 +165,44 @@ def parametrize(plan):
             return NaryPlan(p.op, tuple(walk(ch) for ch in p.children))
         return p  # ConstPlan
 
-    return walk(plan), np.asarray(params, dtype=np.int32)
+    slotted = walk(plan)
+    arr = np.asarray(params, dtype=np.int32)
+    if trace:
+        return slotted, arr, prov, guards
+    return slotted, arr
 
 
 # -- resolution: pql.Call -> plan IR ---------------------------------------
 
 class Resolver:
     """Resolves bitmap calls against a holder's schema (host-side, once per
-    query)."""
+    query).
 
-    def __init__(self, holder, index_name: str):
+    With a ``guard_sink`` list attached, every schema/value-dependent branch
+    taken on a tagged literal (pql.ast.LitInt) appends an interval constraint
+    (lit, lo, hi) under which the SAME branch would be taken again — the
+    prepared-statement cache replays the resolved plan only while all guards
+    hold.  ``uncacheable`` is set when the resolution depends on state that
+    can change between calls with identical text (e.g. "now" for an omitted
+    time-range end)."""
+
+    def __init__(self, holder, index_name: str, guard_sink=None):
         self.holder = holder
         self.index = holder.index(index_name)
         if self.index is None:
             raise PlanError(f"index not found: {index_name}")
         self.index_name = index_name
+        self.guard_sink = guard_sink
+        self.uncacheable = False
+
+    def _guard(self, value, lo=None, hi=None):
+        """Record: the branch just taken holds while lo <= value <= hi."""
+        from ..pql.ast import LitInt
+        if self.guard_sink is None or not isinstance(value, LitInt):
+            return
+        lo = -(1 << 62) if lo is None else lo
+        hi = (1 << 62) if hi is None else hi
+        self.guard_sink.append((value.lit, lo - value.add, hi - value.add))
 
     def field(self, name: str) -> Field:
         f = self.index.field(name)
@@ -222,7 +279,9 @@ class Resolver:
         if to_arg:
             to_time = tq.parse_time(to_arg)
         else:
-            # executor.go:1506: now + 1 day when "to" omitted
+            # executor.go:1506: now + 1 day when "to" omitted — the view set
+            # depends on the wall clock, so the resolution can't be replayed
+            self.uncacheable = True
             to_time = (datetime.now(timezone.utc).replace(tzinfo=None)
                        + timedelta(days=1))
         views = tuple(tq.views_by_time_range(
@@ -247,10 +306,33 @@ class Resolver:
             return BSIPlan(field_name, view, "notnull")
         if cond.op == BETWEEN:
             lo, hi = cond.value
-            if hi < vmin or lo > vmax:
+            if hi < vmin:
+                self._guard(hi, hi=vmin - 1)
                 return BSIPlan(field_name, view, "empty")
+            if lo > vmax:
+                self._guard(hi, lo=vmin)
+                self._guard(lo, lo=vmax + 1)
+                return BSIPlan(field_name, view, "empty")
+            self._guard(hi, lo=vmin)
+            self._guard(lo, hi=vmax)
             if lo <= f.options.min and hi >= f.options.max:
+                self._guard(lo, hi=f.options.min)
+                self._guard(hi, lo=f.options.max)
                 return BSIPlan(field_name, view, "notnull")
+            # at least one of (lo > min, hi < max) held; pin the observed one
+            if lo > f.options.min:
+                self._guard(lo, lo=f.options.min + 1)
+            else:
+                self._guard(hi, hi=f.options.max - 1)
+            # pin the clamp branches of max(lo, vmin) / min(hi, vmax)
+            if lo >= vmin:
+                self._guard(lo, lo=vmin)
+            else:
+                self._guard(lo, hi=vmin - 1)
+            if hi <= vmax:
+                self._guard(hi, hi=vmax)
+            else:
+                self._guard(hi, lo=vmax + 1)
             lo_b = max(lo, vmin) - base
             hi_b = min(hi, vmax) - base
             return BSIPlan(field_name, view, "between", lo_b, hi_b)
@@ -260,33 +342,60 @@ class Resolver:
             raise PlanError("Row(): conditions only support integer values")
 
         # full-encompass fast paths -> notNull (executor.go:1650)
-        if (cond.op == LT and value > f.options.max) or \
-           (cond.op == LTE and value >= f.options.max) or \
-           (cond.op == GT and value < f.options.min) or \
-           (cond.op == GTE and value <= f.options.min):
+        if cond.op == LT and value > f.options.max:
+            self._guard(value, lo=f.options.max + 1)
             return BSIPlan(field_name, view, "notnull")
+        if cond.op == LTE and value >= f.options.max:
+            self._guard(value, lo=f.options.max)
+            return BSIPlan(field_name, view, "notnull")
+        if cond.op == GT and value < f.options.min:
+            self._guard(value, hi=f.options.min - 1)
+            return BSIPlan(field_name, view, "notnull")
+        if cond.op == GTE and value <= f.options.min:
+            self._guard(value, hi=f.options.min)
+            return BSIPlan(field_name, view, "notnull")
+        # fast paths not taken: pin their complements
+        if cond.op == LT:
+            self._guard(value, hi=f.options.max)
+        elif cond.op == LTE:
+            self._guard(value, hi=f.options.max - 1)
+        elif cond.op == GT:
+            self._guard(value, lo=f.options.min)
+        elif cond.op == GTE:
+            self._guard(value, lo=f.options.min + 1)
 
         # baseValue with out-of-range handling (field.go:1574)
         out_of_range = False
         base_value = 0
         if cond.op in (GT, GTE):
             if value > vmax:
+                self._guard(value, lo=vmax + 1)
                 out_of_range = True
             elif value > vmin:
+                self._guard(value, lo=vmin + 1, hi=vmax)
                 base_value = value - base
             else:
+                self._guard(value, hi=vmin)
                 base_value = vmin - base
         elif cond.op in (LT, LTE):
             if value < vmin:
+                self._guard(value, hi=vmin - 1)
                 out_of_range = True
             elif value > vmax:
+                self._guard(value, lo=vmax + 1)
                 base_value = vmax - base
             else:
+                self._guard(value, lo=vmin, hi=vmax)
                 base_value = value - base
         else:  # EQ / NEQ
-            if value < vmin or value > vmax:
+            if value < vmin:
+                self._guard(value, hi=vmin - 1)
+                out_of_range = True
+            elif value > vmax:
+                self._guard(value, lo=vmax + 1)
                 out_of_range = True
             else:
+                self._guard(value, lo=vmin, hi=vmax)
                 base_value = value - base
 
         if out_of_range:

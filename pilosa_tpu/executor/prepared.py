@@ -1,0 +1,380 @@
+"""Prepared-statement cache: skip parse/resolve/parametrize for repeat
+query shapes.
+
+The engine's per-query host cost is dominated by parsing and plan
+resolution (the reference pays the same per query — pql.peg machine +
+executeCall dispatch — but its per-query device round trip is nanoseconds,
+ours is a full dispatch).  Real databases solve this with statement caches
+keyed by the query text with literals stripped (Postgres fingerprinting,
+Oracle cursor sharing); this module is that, adapted to the plan IR:
+
+1. ``fingerprint`` replaces every integer literal in the PQL text with
+   ``?`` (quoted strings and timestamps are preserved) and extracts the
+   literal values.  The template string is the cache key.
+2. On first sight of a template the query is parsed with literal tagging
+   (pql.parser ``mkint`` -> pql.ast.LitInt), resolved and parametrized with
+   provenance tracing (plan.parametrize(trace=True)), and the resulting
+   slotted plans + batched dispatch structure are stored as a
+   ``PreparedEntry``.
+3. On a hit, the entry rebuilds each group's ``[B, P]`` params matrix from
+   the new literal values with vectorized numpy and dispatches straight to
+   the mesh executor — no parsing, no resolution, no per-call Python.
+
+Safety: replaying a resolved plan with new values is only sound when the
+new values would have taken the same structural branches during
+resolution.  Every value-dependent branch records an interval *guard*
+(plan.Resolver._guard); sign regions and row-id bounds are guarded by
+``parametrize``; literals that never reached a dynamic param slot are
+pinned to exact equality.  Any guard failure falls back to the classic
+path (slower, always correct).  Entries are invalidated by the global
+schema epoch (core.bump_schema_epoch) on DDL or BSI bit-depth growth.
+
+The reference has no equivalent component (its per-query parse cost is
+irrelevant at Go speeds); the closest analog is the executor's per-shape
+executable cache mandated by SURVEY.md §7 ("plan->executable cache keyed by
+call tree shape"), which this extends from compiled kernels up through the
+parser.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from ..core import schema_epoch
+from ..ops import bsi
+from ..pql import parse
+from ..pql.ast import LitInt, Query
+from .plan import Resolver, parametrize
+from .results import Pair, ValCount, sort_pairs
+
+# Integer literals only: quoted strings and bare timestamps pass through
+# unchanged (they stay part of the template).  The lookaround classes keep
+# digits inside identifiers/barewords/floats (``field1``, ``1a2b``, ``1.5``,
+# ``2017-01-01``) out of the value list.
+_FP = re.compile(
+    r"'(?:[^'\\]|\\.)*'"
+    r'|"(?:[^"\\]|\\.)*"'
+    r"|\d{4}-[01]\d-[0-3]\dT\d\d:\d\d"
+    r"|(?<![\w.:-])(-?\d+)(?![\w.:-])")
+
+
+def fingerprint(query: str):
+    """(template, values, spans): the query text with int literals replaced
+    by '?', the literal values in source order, and token-start -> literal
+    index for the parser's mkint hook."""
+    values: list[int] = []
+    spans: dict[int, int] = {}
+
+    def sub(m):
+        if m.group(1) is None:
+            return m.group()
+        spans[m.start(1)] = len(values)
+        values.append(int(m.group(1)))
+        return "?"
+
+    return _FP.sub(sub, query), values, spans
+
+
+_BATCHABLE = {"Count", "Sum", "TopN"}
+_EMPTY_PARAMS = np.zeros(0, dtype=np.int32)
+
+
+class _Group:
+    """One batched dispatch: B same-shape calls -> one executable invocation.
+
+    ``build_params(values)`` reconstructs the [B, P] int32 params matrix:
+    params[b, j] = (sgn*(values[lit]+add) >> shift) & mask for dynamic
+    slots, the prepared constant for the rest — all vectorized.
+    """
+
+    __slots__ = ("kind", "slotted", "call_idxs", "const", "lit", "add",
+                 "sgn", "shift", "mask", "extra")
+
+    def __init__(self, kind, slotted, call_idxs, params_rows, prov_rows,
+                 extra):
+        self.kind = kind
+        self.slotted = slotted
+        self.call_idxs = call_idxs
+        self.extra = extra
+        B = len(call_idxs)
+        P = params_rows[0].size if params_rows else 0
+        self.const = (np.stack(params_rows).astype(np.int64) if P
+                      else np.zeros((B, 0), dtype=np.int64))
+        lit = np.full((B, P), -1, dtype=np.int64)
+        add = np.zeros((B, P), dtype=np.int64)
+        sgn = np.ones((B, P), dtype=np.int64)
+        shift = np.zeros((B, P), dtype=np.int64)
+        mask = np.zeros((B, P), dtype=np.int64)
+        for b, prov in enumerate(prov_rows):
+            for j, p in enumerate(prov):
+                if p is None:
+                    continue
+                l, a, neg, sh, mk = p
+                lit[b, j] = l
+                add[b, j] = a
+                sgn[b, j] = -1 if neg else 1
+                shift[b, j] = sh
+                mask[b, j] = mk
+        self.lit = lit
+        self.add = add
+        self.sgn = sgn
+        self.shift = shift
+        self.mask = mask
+
+    def build_params(self, values: np.ndarray) -> np.ndarray:
+        if self.lit.size == 0:
+            return self.const.astype(np.int32)
+        dyn = self.lit >= 0
+        vals = values[np.where(dyn, self.lit, 0)]
+        computed = ((self.sgn * (vals + self.add)) >> self.shift) & self.mask
+        return np.where(dyn, computed, self.const).astype(np.int32)
+
+
+class PreparedEntry:
+    __slots__ = ("epoch", "n_calls", "groups", "g_lit", "g_lo", "g_hi")
+
+    def __init__(self, epoch, n_calls, groups, guards):
+        self.epoch = epoch
+        self.n_calls = n_calls
+        self.groups = groups
+        if guards:
+            self.g_lit = np.asarray([g[0] for g in guards], dtype=np.int64)
+            self.g_lo = np.asarray([g[1] for g in guards], dtype=np.int64)
+            self.g_hi = np.asarray([g[2] for g in guards], dtype=np.int64)
+        else:
+            self.g_lit = np.zeros(0, dtype=np.int64)
+            self.g_lo = self.g_hi = self.g_lit
+
+    def guards_ok(self, values: np.ndarray) -> bool:
+        if self.g_lit.size == 0:
+            return True
+        v = values[self.g_lit]
+        return bool(np.all((v >= self.g_lo) & (v <= self.g_hi)))
+
+    def run(self, ex, index: str, values: np.ndarray, shards):
+        """Dispatch all groups, then resolve with one device fetch.
+        Returns the results list, in call order."""
+        from .executor import _Pending, _resolve_pendings
+
+        holder = ex.holder
+        if shards is None:
+            idx = holder.index(index)
+            shards = sorted(idx.available_shards())
+        mesh = ex.mesh_exec
+        results: list = [None] * self.n_calls
+        for g in self.groups:
+            params = g.build_params(values)
+            if g.kind == "count":
+                parts = mesh.count_batch_async(g.slotted, params, holder,
+                                               index, shards)
+                for b, i in enumerate(g.call_idxs):
+                    results[i] = _Pending(
+                        parts, lambda hp, b=b: sum(int(p[b]) for p in hp))
+            elif g.kind == "sum":
+                parts = mesh.bsi_sum_batch_async(
+                    g.extra["field"], g.extra["view"], g.slotted, params,
+                    holder, index, shards)
+                base = g.extra["base"]
+
+                def _sum_fin(hp, b, base=base):
+                    total, cnt = 0, 0
+                    for p in hp:
+                        s, c_ = bsi.weighted_sum(p[b])
+                        total += s
+                        cnt += c_
+                    return ValCount(total + cnt * base, cnt)
+
+                for b, i in enumerate(g.call_idxs):
+                    results[i] = _Pending(
+                        parts, lambda hp, b=b: _sum_fin(hp, b))
+            else:  # topn
+                parts = mesh.row_counts_batch_async(
+                    g.extra["field"], g.extra["view"], g.slotted, params,
+                    holder, index, shards)
+
+                def _topn_fin(hp, b, ids, n):
+                    counts = mesh.merge_counts([p[b] for p in hp])
+                    if ids:
+                        pairs = [Pair(int(i), int(counts[i]))
+                                 for i in ids if i < counts.size]
+                    else:
+                        nz = np.nonzero(counts)[0]
+                        pairs = [Pair(int(i), int(counts[i])) for i in nz]
+                    pairs = [p for p in pairs if p.count > 0]
+                    return sort_pairs(pairs, n or None)
+
+                for b, i in enumerate(g.call_idxs):
+                    results[i] = _Pending(
+                        parts,
+                        lambda hp, b=b, ids=g.extra["ids"], n=g.extra["n"]:
+                        _topn_fin(hp, b, ids, n))
+        return _resolve_pendings(results)
+
+
+_UNCACHEABLE = "uncacheable"
+
+
+class PreparedCache:
+    """Template -> PreparedEntry, LRU-bounded; thread-safe."""
+
+    def __init__(self, executor, max_entries: int = 256):
+        self.executor = executor
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+        # observability (surfaced at /debug/vars via utils.stats)
+        self.hits = 0
+        self.misses = 0
+        self.guard_misses = 0
+
+    # -- lookup/execute ----------------------------------------------------
+
+    def attempt(self, index: str, query: str, shards):
+        """Try to serve ``query`` from the cache.  Returns
+        (True, results) on a hit; (False, parsed_query_or_None) on a miss
+        — the parsed AST (literal-tagged, tags invisible to the classic
+        path) is handed back so the caller never parses twice."""
+        template, values, spans = fingerprint(query)
+        key = (index, template)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+        vals = np.asarray(values, dtype=np.int64) if values else \
+            np.zeros(0, dtype=np.int64)
+
+        if entry is _UNCACHEABLE:
+            self.misses += 1
+            return False, None
+        if isinstance(entry, PreparedEntry):
+            if entry.epoch == schema_epoch() and entry.guards_ok(vals):
+                self.hits += 1
+                return True, entry.run(self.executor, index, vals, shards)
+            if entry.epoch != schema_epoch():
+                with self._lock:
+                    self._entries.pop(key, None)
+            else:
+                self.guard_misses += 1
+                return False, None  # entry stays; these values take another
+                #                     branch -> classic path
+
+        # build: tagged parse + prepare; on ineligibility remember that
+        self.misses += 1
+        q = parse(query, mkint=lambda v, s: (
+            LitInt(v, spans[s], v - values[spans[s]]) if s in spans else v))
+        entry = self._prepare(index, q, values)
+        with self._lock:
+            self._entries[key] = entry if entry is not None else _UNCACHEABLE
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+        if entry is not None:
+            return True, entry.run(self.executor, index, vals, shards)
+        return False, q
+
+    # -- preparation -------------------------------------------------------
+
+    def _prepare(self, index: str, q: Query, values) -> PreparedEntry | None:
+        """Resolve + parametrize every call with provenance; None when the
+        template can't be soundly cached (non-batchable calls, key
+        translation, wall-clock-dependent time ranges)."""
+        ex = self.executor
+        if ex.mesh_exec is None:
+            return None
+        if ex.translator.needs_translation(index):
+            return None
+        if ex.holder.index(index) is None:
+            return None  # classic path raises the proper error
+        epoch = schema_epoch()
+        guards: list = []
+        descs: list = []
+        for c in q.calls:
+            if c.name not in _BATCHABLE:
+                return None
+            d = self._desc(index, c, guards)
+            if d is None:
+                return None
+            descs.append(d)
+
+        # literals that never reached a dynamic param slot are structural:
+        # pin them to exact equality
+        dyn_lits = set()
+        for d in descs:
+            for p in d["prov"]:
+                if p is not None:
+                    dyn_lits.add(p[0])
+        for i, v in enumerate(values):
+            if i not in dyn_lits:
+                guards.append((i, v, v))
+
+        groups: dict[tuple, list[int]] = {}
+        for i, d in enumerate(descs):
+            groups.setdefault(d["key"], []).append(i)
+        built = []
+        for key, idxs in groups.items():
+            ds = [descs[i] for i in idxs]
+            built.append(_Group(ds[0]["kind"], ds[0]["slotted"], idxs,
+                                [d["params"] for d in ds],
+                                [d["prov"] for d in ds], ds[0]["extra"]))
+        return PreparedEntry(epoch, len(q.calls), built, guards)
+
+    def _desc(self, index: str, c, guards: list):
+        """Traced analog of Executor._batch_desc.  Appends guards; returns
+        None for anything the batched executables can't express."""
+        ex = self.executor
+        sink: list = []
+        resolver = Resolver(ex.holder, index, guard_sink=sink)
+
+        def slot_plan(call):
+            plan = resolver.resolve_bitmap(call)
+            return parametrize(plan, trace=True)
+
+        if c.name == "Count":
+            if len(c.children) != 1:
+                return None
+            slotted, params, prov, pg = slot_plan(c.children[0])
+            if resolver.uncacheable:
+                return None
+            guards.extend(sink)
+            guards.extend(pg)
+            return {"kind": "count", "key": ("count", repr(slotted)),
+                    "slotted": slotted, "params": params, "prov": prov,
+                    "extra": None}
+        if c.name == "Sum":
+            f = ex._bsi_field(index, c)
+            if c.children:
+                slotted, params, prov, pg = slot_plan(c.children[0])
+            else:
+                slotted, params, prov, pg = None, _EMPTY_PARAMS, [], []
+            if resolver.uncacheable:
+                return None
+            guards.extend(sink)
+            guards.extend(pg)
+            return {"kind": "sum", "key": ("sum", f.name, repr(slotted)),
+                    "slotted": slotted, "params": params, "prov": prov,
+                    "extra": {"field": f.name, "view": f.bsi_view_name(),
+                              "base": f.options.base}}
+        # TopN
+        field_name, ok = c.string_arg("_field")
+        if not ok or ex.holder.field(index, field_name) is None:
+            return None
+        if c.children:
+            slotted, params, prov, pg = slot_plan(c.children[0])
+        else:
+            slotted, params, prov, pg = None, _EMPTY_PARAMS, [], []
+        if resolver.uncacheable:
+            return None
+        guards.extend(sink)
+        guards.extend(pg)
+        n, _ = c.uint_arg("n")
+        ids = c.args.get("ids")
+        if ids is not None:
+            ids = [int(x) for x in ids]
+        from ..core import VIEW_STANDARD
+        return {"kind": "topn", "key": ("topn", field_name, repr(slotted)),
+                "slotted": slotted, "params": params, "prov": prov,
+                "extra": {"field": field_name, "view": VIEW_STANDARD,
+                          "ids": ids, "n": n}}

@@ -76,8 +76,14 @@ class MeshExecutor:
         # stale entry (shard set grew, index deleted) pins a full stacked
         # copy of its fragments in device memory until evicted.
         from collections import OrderedDict
+        import threading
         self._stack_cache: OrderedDict = OrderedDict()
         self.stack_cache_max = 64
+        # Concurrent request threads share this executor (the server
+        # overlaps in-flight query batches to hide the dispatch round
+        # trip); the lock covers the python-side cache bookkeeping only —
+        # device dispatch runs outside it.
+        self._lock = threading.RLock()
 
     # -- compiled executables ---------------------------------------------
 

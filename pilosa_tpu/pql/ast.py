@@ -13,6 +13,34 @@ from typing import Any
 # Condition operators (pql/token.go / ast.go Condition).
 LT, LTE, GT, GTE, EQ, NEQ, BETWEEN = "<", "<=", ">", ">=", "==", "!=", "><"
 
+
+class LitInt(int):
+    """An int carrying the provenance of the query-string literal it came
+    from: ``lit`` is the literal's index in the fingerprint's value list and
+    ``add`` the affine offset applied since (e.g. the ±1 strict-bound
+    adjustment of `4 <= x < 9`, or a BSI base subtraction).  Behaves as a
+    plain int everywhere; only the prepared-statement cache
+    (executor/prepared.py) looks at the tags.  Affine arithmetic preserves
+    provenance; everything else decays to int."""
+
+    def __new__(cls, value, lit: int, add: int = 0):
+        x = super().__new__(cls, value)
+        x.lit = lit
+        x.add = add
+        return x
+
+    def __add__(self, other):
+        if type(other) is int:
+            return LitInt(int(self) + other, self.lit, self.add + other)
+        return int(self) + other
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        if type(other) is int:
+            return LitInt(int(self) - other, self.lit, self.add - other)
+        return int(self) - other
+
 _COND_STRINGS = {LT: "<", LTE: "<=", GT: ">", GTE: ">=", EQ: "==",
                  NEQ: "!=", BETWEEN: "><"}
 

@@ -43,9 +43,13 @@ _COND_OPS = ("><", "<=", ">=", "==", "!=", "<", ">")  # longest-first
 
 
 class _Parser:
-    def __init__(self, text: str):
+    def __init__(self, text: str, mkint=None):
         self.text = text
         self.pos = 0
+        # mkint(value, token_start) -> int: literal-construction hook used
+        # by the prepared-statement cache to tag integer literals with their
+        # source position (executor/prepared.py).  Default: identity.
+        self.mkint = mkint or (lambda v, start: v)
 
     # -- low-level ---------------------------------------------------------
 
@@ -321,6 +325,7 @@ class _Parser:
     def _try_conditional(self):
         """conditional <- condint condLT condfield condLT condint
         e.g. `4 <= x < 9` (ast.go:81 endConditional)."""
+        lo_start = self.pos
         lo_s = self.match(_INT)
         if lo_s is None:
             return None
@@ -337,14 +342,16 @@ class _Parser:
         if op2 is None:
             return None
         self.sp()
+        hi_start = self.pos
         hi_s = self.match(_INT)
         if hi_s is None:
             return None
-        lo, hi = int(lo_s), int(hi_s)
+        lo = self.mkint(int(lo_s), lo_start)
+        hi = self.mkint(int(hi_s), hi_start)
         if op1 == "<":
-            lo += 1
+            lo = lo + 1
         if op2 == "<":
-            hi -= 1
+            hi = hi - 1
         return f, Condition(BETWEEN, [lo, hi])
 
     def _field_name(self) -> str:
@@ -361,10 +368,11 @@ class _Parser:
         self.sp()
         if self.peek("'") or self.peek('"'):
             return self._quoted_string()
+        start = self.pos
         u = self.match(_UINT)
         if u is None:
             raise self.err("expected column/row id or quoted key")
-        return int(u)
+        return self.mkint(int(u), start)
 
     def _quoted_string(self) -> str:
         quote = self.text[self.pos]
@@ -435,6 +443,7 @@ class _Parser:
             return ts
         if self.peek('"') or self.peek("'"):
             return self._quoted_string()
+        start = self.pos
         m = self.match(_NUMBER)
         if m is not None:
             # bareword that starts with digits (e.g. 1a2b) must win over a
@@ -450,7 +459,7 @@ class _Parser:
                     # int64 range, like the reference's strconv.ParseInt
                     # failure (ast.go addNumVal)
                     raise self.err(f"integer out of int64 range: {m}")
-                return v
+                return self.mkint(v, start)
         save = self.pos
         ident = self.match(_IDENT)
         if ident is not None:
@@ -464,6 +473,7 @@ class _Parser:
         raise self.err("expected a value")
 
 
-def parse(text: str) -> Query:
-    """(pql/parser.go:48 ParseString)"""
-    return _Parser(text).parse()
+def parse(text: str, mkint=None) -> Query:
+    """(pql/parser.go:48 ParseString).  ``mkint`` tags integer literals with
+    source positions for the prepared-statement cache."""
+    return _Parser(text, mkint).parse()

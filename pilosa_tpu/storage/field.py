@@ -325,6 +325,8 @@ class Field:
             required = max(bit_depth(base_value), 1)
             if required > self.options.bit_depth:
                 self.options.bit_depth = required
+                from ..core import bump_schema_epoch
+                bump_schema_epoch()
                 self.save_meta()
             depth = self.options.bit_depth
         shard = col // SHARD_WIDTH
@@ -424,6 +426,8 @@ class Field:
                 bit_depth(int(base_values.max())), 1)
             if required > self.options.bit_depth:
                 self.options.bit_depth = required
+                from ..core import bump_schema_epoch
+                bump_schema_epoch()
                 self.save_meta()
             depth = self.options.bit_depth
         view = self._create_view_if_not_exists(self.bsi_view_name())

@@ -71,6 +71,8 @@ class Holder:
             idx.translate_factory = self.translate_factory
             idx.save_meta()
             self.indexes[name] = idx
+            from ..core import bump_schema_epoch
+            bump_schema_epoch()
             return idx
 
     def create_index_if_not_exists(self, name: str, **kw) -> Index:
@@ -84,6 +86,8 @@ class Holder:
             idx = self.indexes.pop(name, None)
             if idx is None:
                 raise ValueError(f"index not found: {name}")
+            from ..core import bump_schema_epoch
+            bump_schema_epoch()
             idx.close()
             if idx.path is not None and os.path.isdir(idx.path):
                 shutil.rmtree(idx.path)

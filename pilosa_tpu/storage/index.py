@@ -143,6 +143,8 @@ class Index:
             f = self._make_field(name, options)
             f.save_meta()
             self.fields[name] = f
+            from ..core import bump_schema_epoch
+            bump_schema_epoch()
             return f
 
     def create_field_if_not_exists(self, name: str,
@@ -157,6 +159,8 @@ class Index:
             f = self.fields.pop(name, None)
             if f is None:
                 raise IndexError_(f"field not found: {name}")
+            from ..core import bump_schema_epoch
+            bump_schema_epoch()
             f.close()
             if f.path is not None and os.path.isdir(f.path):
                 import shutil

@@ -1,0 +1,167 @@
+"""Differential tests for the prepared-statement cache (executor/prepared).
+
+Every test drives the SAME query text through (a) a mesh executor whose
+prepared cache serves repeats and (b) a fresh classic executor with the
+cache disabled, asserting identical results — the analog of the kernel
+suite's numpy-oracle differential strategy (SURVEY.md §5.2), applied to
+the statement-cache layer where a stale or mis-guarded replay would be a
+silent wrong answer.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import SHARD_WIDTH
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor.prepared import fingerprint
+from pilosa_tpu.storage import FieldOptions, Holder
+
+
+@pytest.fixture(scope="module")
+def holder():
+    rng = np.random.default_rng(3)
+    h = Holder(None)
+    idx = h.create_index("prep", track_existence=True)
+    f = idx.create_field("f")
+    n = 20_000
+    rows = rng.integers(0, 16, size=n)
+    cols = rng.integers(0, 2 * SHARD_WIDTH, size=n)
+    f.import_bits(rows, cols)
+    idx.add_existence(cols)
+    v = idx.create_field("v", FieldOptions(type="int", min=-500, max=500))
+    vcols = np.unique(rng.integers(0, 2 * SHARD_WIDTH, size=5000))
+    vvals = rng.integers(-500, 501, size=vcols.size)
+    v.import_values(vcols, vvals)
+    idx.add_existence(vcols)
+    return h
+
+
+@pytest.fixture(scope="module")
+def cached(holder):
+    return Executor(holder, use_mesh=True)
+
+
+@pytest.fixture()
+def classic(holder):
+    ex = Executor(holder, use_mesh=True)
+    ex.prepared = None  # same mesh engine, no statement cache
+    return ex
+
+
+def test_fingerprint_literals():
+    t, vals, spans = fingerprint(
+        "Count(Row(f=14)) Row(v > -3) TopN(f, n=50, ids=[1,2])")
+    assert t == "Count(Row(f=?)) Row(v > ?) TopN(f, n=?, ids=[?,?])"
+    assert vals == [14, -3, 50, 1, 2]
+    assert len(spans) == 5
+
+
+def test_fingerprint_preserves_strings_timestamps_and_words():
+    q = ("Row(f=7, from='2017-01-01T00:00', to=2018-06-02T11:30) "
+         "Set('k9', f=3) Count(Row(g1=1a2b)) Row(x=1.5)")
+    t, vals, _ = fingerprint(q)
+    assert "'2017-01-01T00:00'" in t
+    assert "2018-06-02T11:30" in t
+    assert "'k9'" in t
+    assert "1a2b" in t
+    assert "1.5" in t
+    assert vals == [7, 3]
+
+
+def _check(cached, classic, queries):
+    """Same template, varying literals: first query populates the cache,
+    the rest replay it; classic executor must agree on every one."""
+    for q in queries:
+        assert cached.execute("prep", q) == classic.execute("prep", q), q
+
+
+def test_count_row_replay(cached, classic):
+    _check(cached, classic,
+           [f"Count(Row(f={r}))" for r in (1, 5, 0, 15, 9, 400)])
+    assert cached.prepared.hits > 0
+
+
+def test_multi_call_batch_replay(cached, classic):
+    rng = np.random.default_rng(11)
+    qs = []
+    for _ in range(3):
+        rows = rng.integers(0, 16, size=8)
+        qs.append(" ".join(
+            f"Count(Intersect(Row(f={a}), Row(f={b})))"
+            for a, b in zip(rows[::2], rows[1::2])))
+    _check(cached, classic, qs)
+
+
+def test_bsi_regime_guards(cached, classic):
+    # values crossing every _resolve_bsi branch: normal, clamp, fast-path
+    # notnull, out-of-range empty, sign flip, zero
+    vals = [5, -5, 0, 499, 500, 501, -499, -500, -501, 1000, -1000,
+            2000, 100000]
+    _check(cached, classic, [f"Count(Row(v > {x}))" for x in vals])
+    _check(cached, classic, [f"Count(Row(v < {x}))" for x in vals])
+    _check(cached, classic, [f"Count(Row(v == {x}))" for x in vals])
+    _check(cached, classic, [f"Count(Row(v != {x}))" for x in vals])
+    _check(cached, classic, [f"Count(Row(v >= {x}))" for x in vals])
+    _check(cached, classic, [f"Count(Row(v <= {x}))" for x in vals])
+
+
+def test_between_guards(cached, classic):
+    pairs = [(0, 10), (-10, 10), (-500, 500), (-501, 501), (-2000, -600),
+             (600, 2000), (5, 5), (490, 510), (-510, -490)]
+    _check(cached, classic,
+           [f"Count(Row({lo} <= v <= {hi}))" for lo, hi in pairs])
+    _check(cached, classic,
+           [f"Count(Row({lo} < v < {hi}))" for lo, hi in pairs])
+
+
+def test_sum_and_topn_replay(cached, classic):
+    _check(cached, classic,
+           [f"Sum(Row(v > {x}), field=v)" for x in (0, 100, -100, 499)])
+    _check(cached, classic,
+           [f"TopN(f, Row(v > {x}), n=5)" for x in (0, 50, -50)])
+    # structural literal (n) change -> equality guard miss -> still correct
+    _check(cached, classic, ["TopN(f, Row(v > 10), n=3)"])
+
+
+def test_row_id_beyond_capacity(cached, classic):
+    _check(cached, classic, ["Count(Row(f=2))", "Count(Row(f=500000))"])
+
+
+def test_epoch_invalidation(cached, classic, holder):
+    q = "Count(Row(f=3))"
+    assert cached.execute("prep", q) == classic.execute("prep", q)
+    # DDL bumps the schema epoch; the entry must not be replayed stale
+    holder.index("prep").create_field("tmp_epoch")
+    holder.index("prep").delete_field("tmp_epoch")
+    assert cached.execute("prep", q) == classic.execute("prep", q)
+
+
+def test_writes_not_cached(cached, holder):
+    q = "Set(999999, f=2)"
+    cached.execute("prep", q)
+    assert (("prep", fingerprint(q)[0]) not in
+            [k for k, v in cached.prepared._entries.items()
+             if not isinstance(v, str)])
+    # the write actually landed and reads observe it
+    assert cached.execute("prep", "Count(Row(f=2))")[0] == \
+        cached.execute("prep", "Count(Row(f = 2))")[0]
+    holder.field("prep", "f").clear_bit(2, 999999)
+
+
+def test_mutation_invalidates_results_not_plan(cached, classic, holder):
+    """A Set between two identical-template queries must be visible —
+    the plan replays but the data path re-reads the fragments."""
+    q = "Count(Row(f=6))"
+    before = cached.execute("prep", q)[0]
+    col = 3 * SHARD_WIDTH - 7  # within existing shards
+    changed = holder.field("prep", "f").set_bit(6, col)
+    after = cached.execute("prep", q)[0]
+    assert after == before + (1 if changed else 0)
+    assert cached.execute("prep", q) == classic.execute("prep", q)
+    holder.field("prep", "f").clear_bit(6, col)
+
+
+def test_conditional_both_bounds_dynamic(cached, classic):
+    qs = ["Count(Row(4 <= v < 9))", "Count(Row(-3 <= v < 100))",
+          "Count(Row(0 <= v < 1))"]
+    _check(cached, classic, qs)
