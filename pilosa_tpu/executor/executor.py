@@ -97,6 +97,10 @@ class Executor:
             self.mesh_exec = MeshExecutor(mesh)
             self.prepared = PreparedCache(self)
 
+    def close(self):
+        if self.mesh_exec is not None:
+            self.mesh_exec.close()
+
     # -- entry point (executor.go:113 Execute) -----------------------------
 
     def execute(self, index_name: str, query, shards=None,

@@ -77,8 +77,14 @@ class MeshExecutor:
         # copy of its fragments in device memory until evicted.
         from collections import OrderedDict
         import threading
+        from ..storage.membudget import DEFAULT_BUDGET
         self._stack_cache: OrderedDict = OrderedDict()
         self.stack_cache_max = 64
+        self._budget = DEFAULT_BUDGET
+        import weakref
+        self._finalizer = weakref.finalize(
+            self, MeshExecutor._cleanup_budget, self._budget, id(self),
+            self._stack_cache)
         # Concurrent request threads share this executor (the server
         # overlaps in-flight query batches to hide the dispatch round
         # trip); the lock covers the python-side cache bookkeeping only —
@@ -144,30 +150,40 @@ class MeshExecutor:
         [(field, view), ...] and stack+place each group's fragments over
         the mesh axis.  Returns [(shard_list, placed_per_key, shapes)];
         ``placed_per_key[i]`` is None when key i's fragment is absent in
-        the whole group.  Results are cached against the fragments' device
-        mirrors so repeat queries reuse the resident blocks."""
-        per_shard: list[list] = []
-        for shard in shards:
-            arrays = []
-            for field, view in keys:
-                frag = holder.fragment(index, field, view, shard)
-                arrays.append(
-                    None if frag is None
-                    else frag.device(self.stage_device))
-            per_shard.append(arrays)
-        token = tuple(0 if a is None else id(a)
-                      for arrays in per_shard for a in arrays)
-        ckey = (index, tuple(keys), tuple(shards))
-        cached = self._stack_cache.get(ckey)
-        if cached is not None and cached[0] == token:
-            self._stack_cache.move_to_end(ckey)
-            return cached[1]
+        the whole group.
 
+        Results are cached against the fragments' data-generation stamps
+        (fragment.gen) so repeat queries reuse the resident stacked blocks
+        without touching (or pinning) the per-fragment mirrors at all; the
+        stacked bytes register with the DeviceBudget so HBM pressure can
+        evict whole stacks (r3 advisor).  A budget-eviction callback may
+        pop entries concurrently from outside ``self._lock`` (it must not
+        lock: two executors evicting each other's entries would deadlock),
+        so every cache op here tolerates a vanished key."""
+        frags = [[holder.fragment(index, field, view, shard)
+                  for field, view in keys] for shard in shards]
+        token = tuple(-1 if fr is None else fr.gen
+                      for row in frags for fr in row)
+        ckey = (index, tuple(keys), tuple(shards))
+        skey = ("stack", id(self), ckey)
+        with self._lock:
+            cached = self._stack_cache.get(ckey)
+            if cached is not None and cached[0] == token:
+                try:
+                    self._stack_cache.move_to_end(ckey)
+                except KeyError:
+                    pass  # evicted between get and move: still usable
+                self._budget.touch(skey)
+                return cached[1]
+
+        per_shard = [[None if fr is None else fr.device(self.stage_device)
+                      for fr in row] for row in frags]
         groups: dict[tuple, list[tuple[int, list]]] = {}
         for shard, arrays in zip(shards, per_shard):
             sig = tuple(None if a is None else a.shape for a in arrays)
             groups.setdefault(sig, []).append((shard, arrays))
         out = []
+        nbytes = 0
         for sig, members in groups.items():
             shard_list = [m[0] for m in members]
             placed = []
@@ -175,16 +191,51 @@ class MeshExecutor:
                 if shape is None:
                     placed.append(None)
                 else:
-                    placed.append(self._pad_and_place(
-                        [m[1][i] for m in members], shape, len(members)))
+                    p = self._pad_and_place(
+                        [m[1][i] for m in members], shape, len(members))
+                    nbytes += p.nbytes
+                    placed.append(p)
             out.append((shard_list, placed, sig))
-        # token holds mirror ids; keeping per_shard alive pins the mirrors
-        # so ids stay valid for the cache's lifetime
-        self._stack_cache[ckey] = (token, out, per_shard)
-        self._stack_cache.move_to_end(ckey)
-        while len(self._stack_cache) > self.stack_cache_max:
-            self._stack_cache.popitem(last=False)
+
+        import weakref
+        wself = weakref.ref(self)  # entries must not pin the executor
+
+        def _evict(ck=ckey, tok=token):
+            # Guard on the registration's own token (tuple identity): a
+            # deferred callback that lost a race with a rebuild of the same
+            # key must not drop the fresh entry.
+            s = wself()
+            if s is not None:
+                cur = s._stack_cache.get(ck)
+                if cur is not None and cur[0] is tok:
+                    s._stack_cache.pop(ck, None)
+
+        with self._lock:
+            self._stack_cache[ckey] = (token, out)
+            self._budget.register(skey, nbytes, _evict)
+            while len(self._stack_cache) > self.stack_cache_max:
+                try:
+                    old_key, _ = self._stack_cache.popitem(last=False)
+                except KeyError:
+                    break
+                self._budget.unregister(("stack", id(self), old_key))
         return out
+
+    @staticmethod
+    def _cleanup_budget(budget, exec_id, stack_cache):
+        """Drop this executor's budget accounting (runs on close() or GC —
+        without it, accounting-only budgets would grow phantom resident
+        bytes for every discarded executor)."""
+        for ck in list(stack_cache):
+            budget.unregister(("stack", exec_id, ck))
+        stack_cache.clear()
+
+    def close(self):
+        """Unregister budget entries and drop cached device state (also
+        runs automatically when an un-closed executor is GC'd)."""
+        with self._lock:
+            self._finalizer()
+            self._cache.clear()
 
     def _pad_and_place(self, arrays_list, shape, n: int):
         """Stack n member arrays, pad to a multiple of n_devices, and place

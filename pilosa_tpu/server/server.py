@@ -34,6 +34,10 @@ class Config:
     # execution: serve queries through the device-mesh executor (stacked
     # shard batches + ICI reductions); off = per-shard host dispatch
     use_mesh: bool = True
+    # HBM budget for device-resident fragment mirrors + stacked shard
+    # blocks (storage/membudget.py DeviceBudget — the syswrap map-cap
+    # analog, syswrap/mmap.go:46).  0 = unlimited (accounting only).
+    device_budget_mb: int = 0
     # monitors
     anti_entropy_interval: float = 600.0
     metric_poll_interval: float = 60.0
@@ -60,6 +64,7 @@ class Config:
             "PILOSA_TPU_VERBOSE": ("verbose", lambda s: s == "true"),
             "PILOSA_TPU_MAX_ROW_ID": ("max_row_id", int),
             "PILOSA_TPU_USE_MESH": ("use_mesh", lambda s: s != "false"),
+            "PILOSA_TPU_DEVICE_BUDGET_MB": ("device_budget_mb", int),
         }
         for env, (attr, conv) in env_map.items():
             if env in os.environ:
@@ -82,6 +87,7 @@ class Config:
         mapping = {
             "data-dir": "data_dir", "bind": "bind", "max-op-n": "max_op_n",
             "max-row-id": "max_row_id", "use-mesh": "use_mesh",
+            "device-budget-mb": "device_budget_mb",
         }
         for key, attr in mapping.items():
             if key in doc:
@@ -103,6 +109,13 @@ class Server:
         self.config = config or Config()
         self.logger = Logger(verbose=self.config.verbose)
         self.stats = StatsClient()
+        # The budget is process-wide; the most recent Server's config wins
+        # (0 restores unlimited — a stale limit from an earlier instance in
+        # the same process must not outlive its config).
+        from ..storage.membudget import DEFAULT_BUDGET
+        DEFAULT_BUDGET.limit_bytes = (
+            self.config.device_budget_mb * (1 << 20)
+            if self.config.device_budget_mb > 0 else None)
         data_dir = os.path.expanduser(self.config.data_dir)
         self.holder = Holder(
             data_dir, max_op_n=self.config.max_op_n,
@@ -172,4 +185,5 @@ class Server:
         self.httpd.server_close()
         if self.cluster is not None:
             self.cluster.close()
+        self.api.executor.close()
         self.holder.close()

@@ -36,6 +36,7 @@ grows by doubling so device executable shapes change rarely.
 from __future__ import annotations
 
 import hashlib
+import itertools
 import os
 import struct
 import threading
@@ -123,6 +124,12 @@ class Fragment:
         self._val = np.zeros(0, dtype=np.uint32)
         self._cap_rows = 0        # device-shape row capacity (pow2 growth)
         self._mirrors = {}        # device -> cached jax.Array mirror
+        # Data-generation stamp: unique across all fragments and bumped on
+        # every mutation.  Derived caches (mesh stacked blocks) key their
+        # validity on this instead of mirror identity, so they need not pin
+        # mirrors alive (and a recreated fragment can never alias a stale
+        # cache entry).
+        self.gen = next(self._GEN)
         self._device_dirty = True
         self._op_n = 0
         self._dirty_data = False  # mutated since last snapshot?
@@ -270,9 +277,12 @@ class Fragment:
         self._cap_rows = new_rows
         self._mark_device_dirty()
 
+    _GEN = itertools.count(1)
+
     def _mark_device_dirty(self):
         self._device_dirty = True
         self._dirty_data = True
+        self.gen = next(self._GEN)
 
     # -- sparse store primitives -------------------------------------------
 
@@ -322,7 +332,18 @@ class Fragment:
     def _apply_bits(self, rows, cols, clear: bool) -> int:
         if rows.size == 0:
             return 0
-        self._ensure_rows(int(rows.max()))
+        if clear:
+            # Rows at/above capacity cannot hold set bits: drop them rather
+            # than growing capacity (which would change the device tensor
+            # shape and force a recompile for a guaranteed no-op), and never
+            # raise on row ids beyond the cap — clearing them is a no-op.
+            keep = rows < self._cap_rows
+            if not keep.all():
+                rows, cols = rows[keep], cols[keep]
+            if rows.size == 0:
+                return 0
+        else:
+            self._ensure_rows(int(rows.max()))
         nidx, nval = _pairs_to_words(rows, cols)
         n = self._andnot_words(nidx, nval) if clear \
             else self._or_words(nidx, nval)
@@ -432,13 +453,29 @@ class Fragment:
         urow = np.fromiter(last.values(), dtype=np.int64, count=len(last))
         with self._lock:
             self._ensure_rows(int(urow.max()))
+            # Winner bits already set are cleared by _column_mask_clear and
+            # re-set by _apply_bits; they are no-ops and must not count
+            # (fragment.go:2106 bulkImportMutex reports real changes only).
+            nidx, nval = _pairs_to_words(urow, ucols)
+            pos, exists = self._locate(nidx)
+            pre_winner = int(np.bitwise_count(
+                self._val[pos[exists]] & nval[exists]).sum())
+            gen0, dev_dirty0, data_dirty0 = \
+                self.gen, self._device_dirty, self._dirty_data
             cleared = self._column_mask_clear(ucols)
             set_changed = self._apply_bits(urow, ucols, clear=False)
-            n_changed = cleared + set_changed
+            n_changed = cleared + set_changed - 2 * pre_winner
             if n_changed:
                 self._mark_device_dirty()
-            if self._wal_file is not None:
-                self.snapshot()
+                if self._wal_file is not None:
+                    self.snapshot()
+            else:
+                # idempotent re-import: the store's final state equals its
+                # initial state — restore the stamps so downstream caches
+                # (device mirrors, mesh stacks) are not invalidated
+                self.gen = gen0
+                self._device_dirty = dev_dirty0
+                self._dirty_data = data_dirty0
             return n_changed
 
     def set_row(self, row: int, seg: np.ndarray | None):

@@ -35,7 +35,10 @@ class DeviceBudget:
 
     def register(self, key: tuple, nbytes: int, evict: Callable[[], None]):
         """Account ``nbytes`` under ``key``; ``evict`` drops the owner's
-        reference when called.  Evicts LRU entries first if needed."""
+        reference when called.  Evicts LRU entries first if needed.
+        Eviction callbacks run OUTSIDE the budget lock so owners may take
+        their own locks without ordering against this one."""
+        to_evict: list[Callable[[], None]] = []
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
@@ -46,12 +49,14 @@ class DeviceBudget:
                         self._total + nbytes > self.limit_bytes:
                     _, (freed, cb) = self._entries.popitem(last=False)
                     self._total -= freed
-                    try:
-                        cb()
-                    except Exception:
-                        pass
+                    to_evict.append(cb)
             self._entries[key] = (nbytes, evict)
             self._total += nbytes
+        for cb in to_evict:
+            try:
+                cb()
+            except Exception:
+                pass
 
     def touch(self, key: tuple):
         with self._lock:

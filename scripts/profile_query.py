@@ -1,0 +1,81 @@
+"""Profile host-side per-query overhead on the served path (CPU mesh).
+
+Run:  python scripts/profile_query.py [--cprofile]
+"""
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pilosa_tpu.core import SHARD_WIDTH  # noqa: E402
+from pilosa_tpu.storage import FieldOptions, Holder  # noqa: E402
+from pilosa_tpu.executor import Executor  # noqa: E402
+
+SEED = 7
+
+
+def build():
+    rng = np.random.default_rng(SEED)
+    h = Holder(None)
+    star = h.create_index("startrace", track_existence=False)
+    stargazer = star.create_field("stargazer")
+    n_rows, per_row = 64, 200_000
+    stargazer.import_bits(
+        np.repeat(np.arange(n_rows), per_row),
+        rng.integers(0, SHARD_WIDTH, size=n_rows * per_row))
+    return h, n_rows
+
+
+def batch2(rng, n_rows, B):
+    sets = rng.permuted(np.tile(np.arange(n_rows), (B, 1)), axis=1)[:, :8]
+    return " ".join(
+        "Count(Intersect(" + ", ".join(
+            f"Row(stargazer={r})" for r in q) + "))" for q in sets)
+
+
+def main():
+    h, n_rows = build()
+    ex = Executor(h, use_mesh=True)
+    rng = np.random.default_rng(SEED + 1)
+    B, iters = 128, 10
+
+    # warm
+    ex.execute("startrace", batch2(rng, n_rows, B))
+    ex.execute("startrace", batch2(rng, n_rows, B))
+    pc = ex.prepared
+    print(f"prepared: hits={pc.hits} misses={pc.misses} "
+          f"guard_misses={pc.guard_misses}", file=sys.stderr)
+
+    if "--cprofile" in sys.argv:
+        import cProfile
+        import pstats
+        pr = cProfile.Profile()
+        pr.enable()
+        for _ in range(iters):
+            ex.execute("startrace", batch2(rng, n_rows, B))
+        pr.disable()
+        pstats.Stats(pr).sort_stats("cumulative").print_stats(30)
+    else:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ex.execute("startrace", batch2(rng, n_rows, B))
+        dt = time.perf_counter() - t0
+        print(f"B={B} iters={iters}: {B*iters/dt:.0f} qps, "
+              f"{dt/iters*1e3:.2f} ms/batch, "
+              f"{dt/(B*iters)*1e6:.0f} us/call")
+    print(f"prepared: hits={pc.hits} misses={pc.misses} "
+          f"guard_misses={pc.guard_misses}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -138,6 +138,47 @@ def test_mesh_executor_cache(loaded):
     assert len(me.mesh_exec._cache) == n
 
 
+def test_stacks_register_with_device_budget(loaded):
+    """Stacked shard blocks account against the DeviceBudget and evict as
+    one unit (r3 advisor: stacks bypassed the budget entirely)."""
+    from pilosa_tpu.storage.membudget import DEFAULT_BUDGET
+    h, _, _ = loaded
+    me = Executor(h, use_mesh=True)
+    before = DEFAULT_BUDGET.resident_bytes
+    me.execute("i", "Count(Row(f=1))")
+    assert DEFAULT_BUDGET.resident_bytes > before
+    sc = me.mesh_exec._stack_cache
+    assert len(sc) == 1
+    ckey = next(iter(sc))
+    key = ("stack", id(me.mesh_exec), ckey)
+    assert key in DEFAULT_BUDGET._entries
+    nbytes = DEFAULT_BUDGET._entries[key][0]
+    assert nbytes > 0
+    # budget eviction drops the stack-cache entry
+    DEFAULT_BUDGET._entries[key][1]()
+    assert ckey not in sc
+    DEFAULT_BUDGET.unregister(key)
+    # close() unregisters whatever remains
+    me.execute("i", "Count(Row(f=1))")
+    assert ("stack", id(me.mesh_exec), ckey) in DEFAULT_BUDGET._entries
+    mid = id(me.mesh_exec)
+    me.close()
+    assert ("stack", mid, ckey) not in DEFAULT_BUDGET._entries
+
+
+def test_server_config_sets_device_budget(tmp_path):
+    from pilosa_tpu.server import Config, Server
+    from pilosa_tpu.storage.membudget import DEFAULT_BUDGET
+    old = DEFAULT_BUDGET.limit_bytes
+    try:
+        srv = Server(Config(data_dir=str(tmp_path), bind="localhost:0",
+                            device_budget_mb=256))
+        assert DEFAULT_BUDGET.limit_bytes == 256 << 20
+        srv.httpd.server_close()
+    finally:
+        DEFAULT_BUDGET.limit_bytes = old
+
+
 def test_plan_cache_keyed_by_shape(loaded):
     """Distinct row ids and BSI predicate values must share ONE compiled
     executable — literals are runtime params, not baked constants

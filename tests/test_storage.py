@@ -239,6 +239,37 @@ def test_fragment_row_id_cap():
     assert frag.n_rows == 0  # nothing allocated
 
 
+def test_fragment_clear_above_cap_is_noop():
+    """clear_bit beyond capacity (or even beyond row_id_cap) is a silent
+    no-op: those rows cannot hold set bits, and growing capacity for a
+    clear would force a device-shape recompile (r3 advisor)."""
+    frag = Fragment(None, "i", "f", "standard", 0)
+    frag.set_bit(1, 7)
+    cap = frag.n_rows
+    assert frag.clear_bit(cap + 5, 7) is False
+    assert frag.clear_bit(2 ** 40, 7) is False  # above row_id_cap: no raise
+    assert frag.n_rows == cap  # no capacity growth
+    assert frag.bulk_import(np.array([cap + 1]), np.array([3]),
+                            clear=True) == 0
+    assert frag.n_rows == cap
+
+
+def test_mutex_import_noop_counts_zero():
+    """Re-importing the identical winner bits must report 0 changes
+    (fragment.go:2106 bulkImportMutex reports real deltas; r3 advisor)."""
+    frag = Fragment(None, "i", "f", "standard", 0)
+    rows = np.array([2, 3, 2])
+    cols = np.array([10, 11, 12])
+    first = frag.mutex_import(rows, cols)
+    assert first == 3
+    gen = frag.gen
+    assert frag.mutex_import(rows, cols) == 0
+    assert frag.gen == gen  # no-op must not invalidate derived caches
+    # moving one column to a new row counts the clear and the set
+    assert frag.mutex_import(np.array([4]), np.array([10])) == 2
+    assert frag.gen != gen
+
+
 def test_field_import_values():
     f = Field(None, "i", "f", FieldOptions(type="int", min=-100, max=100))
     cols = np.array([1, SHARD_WIDTH + 2, 3])
