@@ -22,7 +22,7 @@ from ..storage import time_quantum as tq
 from .plan import PlanCompiler, PlanError, Resolver, parametrize
 from .results import (
     FieldRow, GroupCount, Pair, RowIdentifiers, RowResult, ValCount,
-    merge_pairs, sort_pairs,
+    acc_counts, sort_pairs,
 )
 
 BITMAP_CALLS = {"Row", "Range", "Intersect", "Union", "Difference", "Xor",
@@ -33,6 +33,34 @@ WRITE_CALLS = {"Set", "Clear", "ClearRow", "Store", "SetRowAttrs",
 
 class ExecutionError(ValueError):
     pass
+
+
+# TopN args that the batched/prepared fast paths cannot express — queries
+# carrying any of them take the per-call path (and the cluster finalizes
+# them globally at the coordinator).
+TOPN_EXTRAS = ("tanimotoThreshold", "attrName", "attrValues")
+
+
+def topn_extras(c: Call):
+    """(tanimotoThreshold, attrName, attrValues) with the reference's
+    argument validation (executor.go:930-960).  Shared by the local
+    executor and the cluster fan-out (which must finalize these globally —
+    per-node tanimoto would diverge from single-node answers)."""
+    tan_thresh = c.args.get("tanimotoThreshold")
+    attr_name = c.args.get("attrName")
+    attr_values = c.args.get("attrValues")
+    if attr_name is not None and attr_values is None:
+        raise ExecutionError("TopN(attrName=...) requires attrValues")
+    if attr_values is not None and attr_name is None:
+        raise ExecutionError("TopN(attrValues=...) requires attrName")
+    if tan_thresh is not None:
+        if not isinstance(tan_thresh, int) or isinstance(tan_thresh, bool) \
+                or not 0 < tan_thresh <= 100:
+            raise ExecutionError(
+                "tanimotoThreshold must be an integer in (0, 100]")
+        if not c.children:
+            raise ExecutionError("tanimotoThreshold requires a source row")
+    return tan_thresh, attr_name, attr_values
 
 
 class _Pending:
@@ -165,6 +193,8 @@ class Executor:
                      "field": f.name, "view": f.bsi_view_name(),
                      "base": f.options.base})
         if c.name == "TopN":
+            if any(k in c.args for k in TOPN_EXTRAS):
+                return None  # extras need extra passes: per-call path
             field_name, ok = c.string_arg("_field")
             if not ok or self.holder.field(index, field_name) is None:
                 return None  # per-call path raises the proper error
@@ -450,6 +480,40 @@ class Executor:
 
     # -- TopN (executor.go:860 executeTopN, fragment.go:1570 top) ----------
 
+    @staticmethod
+    def _topn_finalize(counts, row_tot, src_count, ids, n, tan_thresh,
+                       attr_name, attr_values, field) -> list[Pair]:
+        """Shared tail of TopN: tanimoto/attr row filtering + ranking.
+
+        Tanimoto (fragment.go:1704 topBitmapPairs): keep rows where
+        100*|row∩src| >= threshold*(|row|+|src|-|row∩src|).  Computed on
+        GLOBAL counts (across all shards) rather than per shard — exact
+        where the reference's per-shard cache heuristic is approximate.
+        Attr filter (executor.go:942-995): keep rows whose row-attribute
+        ``attr_name`` value is in ``attr_values``."""
+        if tan_thresh:
+            size = max(counts.size, row_tot.size)
+            c_ = np.zeros(size, dtype=np.int64)
+            c_[: counts.size] = counts
+            t_ = np.zeros(size, dtype=np.int64)
+            t_[: row_tot.size] = row_tot
+            denom = t_ + src_count - c_
+            ok = (denom > 0) & (100 * c_ >= tan_thresh * denom)
+            counts = np.where(ok, c_, 0)
+        if ids:
+            pairs = [Pair(int(i), int(counts[i]))
+                     for i in ids if i < counts.size]
+        else:
+            nz = np.nonzero(counts)[0]
+            pairs = [Pair(int(i), int(counts[i])) for i in nz]
+        pairs = [p for p in pairs if p.count > 0]
+        if attr_name is not None:
+            allowed = set(attr_values)
+            pairs = [p for p in pairs
+                     if field.row_attrs.attrs(p.id).get(attr_name)
+                     in allowed]
+        return sort_pairs(pairs, n or None)
+
     def _execute_topn(self, index: str, c: Call, shards) -> list[Pair]:
         field_name, ok = c.string_arg("_field")
         if not ok:
@@ -459,31 +523,50 @@ class Executor:
             raise ExecutionError(f"field not found: {field_name}")
         n, _ = c.uint_arg("n")
         ids = c.args.get("ids")
+        tan_thresh, attr_name, attr_values = topn_extras(c)
 
         if self.mesh_exec is not None:
             # one shard_map computation: per-row popcounts masked by the
             # filter plan, psum'd over the shard axis (fragment.go:1570 top
-            # collapsed into a single ICI all-reduce)
+            # collapsed into a single ICI all-reduce); tanimoto adds an
+            # unfiltered pass + the src count, all dispatched before the
+            # single blocking fetch
+            filter_plan = self._filter_plan(index, c)
             parts = self.mesh_exec.row_counts_async(
-                field_name, VIEW_STANDARD, self._filter_plan(index, c),
+                field_name, VIEW_STANDARD, filter_plan,
                 self.holder, index, shards)
+            parts_u, parts_src = [], []
+            if tan_thresh:
+                parts_u = self.mesh_exec.row_counts_async(
+                    field_name, VIEW_STANDARD, None, self.holder, index,
+                    shards)
+                parts_src = self.mesh_exec.count_async(
+                    filter_plan, self.holder, index, shards)
+            k, ku = len(parts), len(parts_u)
 
             def _fin(hp, ids=ids, n=n):
-                counts = self.mesh_exec.merge_counts(hp)
-                if ids:
-                    pairs = [Pair(int(i), int(counts[i]))
-                             for i in ids if i < counts.size]
-                else:
-                    nz = np.nonzero(counts)[0]
-                    pairs = [Pair(int(i), int(counts[i])) for i in nz]
-                pairs = [p for p in pairs if p.count > 0]
-                return sort_pairs(pairs, n or None)
+                counts = self.mesh_exec.merge_counts(hp[:k])
+                row_tot = self.mesh_exec.merge_counts(hp[k: k + ku]) \
+                    if tan_thresh else None
+                src = sum(int(x) for x in hp[k + ku:]) if tan_thresh else 0
+                return self._topn_finalize(
+                    counts, row_tot, src, ids, n, tan_thresh, attr_name,
+                    attr_values, f)
 
-            return _Pending(parts, _fin)
+            return _Pending(parts + parts_u + parts_src, _fin)
 
         filters = self._filter_segments(index, c, shards)
         v = f.view(VIEW_STANDARD)
-        per_shard: list[list[Pair]] = []
+        counts = np.zeros(0, dtype=np.int64)
+        row_tot = np.zeros(0, dtype=np.int64)
+        src_count = 0
+        if tan_thresh and filters is not None:
+            # src is counted over ALL shards — including ones where the
+            # TopN field has no fragment (the mesh path's count_async does
+            # the same; skipping them would shrink the denominator)
+            src_count = sum(
+                int(np.asarray(bitset.count(seg)))
+                for seg in filters.values())
         for shard in shards:
             frag = None if v is None else v.fragment(shard)
             if frag is None or frag.n_rows == 0:
@@ -495,18 +578,12 @@ class Executor:
                     bitset.intersect(dev, filt[None, :]))
             else:
                 counts_dev = bitset.row_counts(dev)
-            counts = np.asarray(counts_dev)
-            if ids:
-                sel = [i for i in ids if i < counts.size]
-                per_shard.append(
-                    [Pair(int(i), int(counts[i])) for i in sel])
-            else:
-                nz = np.nonzero(counts)[0]
-                per_shard.append(
-                    [Pair(int(i), int(counts[i])) for i in nz])
-        pairs = merge_pairs(per_shard)
-        pairs = [p for p in pairs if p.count > 0]
-        return sort_pairs(pairs, n or None)
+            counts = acc_counts(counts, np.asarray(counts_dev))
+            if tan_thresh:
+                row_tot = acc_counts(
+                    row_tot, np.asarray(bitset.row_counts(dev)))
+        return self._topn_finalize(counts, row_tot, src_count, ids, n,
+                                   tan_thresh, attr_name, attr_values, f)
 
     # -- Rows (executor.go:1274 executeRows) -------------------------------
 
@@ -593,6 +670,26 @@ class Executor:
             ids = self._execute_rows(index, rc, shards).rows
             fields.append((fname, ids))
 
+        # previous=[row per Rows child]: resume pagination strictly after
+        # that group (executor.go:1403, :3058 groupByIterator seek)
+        previous = c.args.get("previous")
+        prev_ids = None
+        if previous is not None:
+            if not isinstance(previous, list) or \
+                    len(previous) != len(fields):
+                raise ExecutionError(
+                    "GroupBy previous= must list one row per Rows child")
+            prev_ids = tuple(int(p) for p in previous)
+
+        def _paginate(groups_out):
+            if prev_ids is not None:
+                groups_out = [
+                    g for g in groups_out
+                    if tuple(fr.row_id for fr in g.group) > prev_ids]
+            if limit is not None:
+                groups_out = groups_out[:limit]
+            return groups_out
+
         # Count each combination: per shard, AND the group rows' segments +
         # optional filter, popcount.  The innermost field is batched on
         # device; on the mesh path the whole inner loop is ONE psum'd
@@ -614,22 +711,46 @@ class Executor:
                            if filt_call is not None else None)
             prefix_keys = [(fname, VIEW_STANDARD) for fname, _ in
                            prefix_fields]
-            for combo in prefix_combos():
-                counts = self.mesh_exec.group_counts(
-                    (last_field, VIEW_STANDARD), prefix_keys,
-                    [rid for _, rid in combo], filter_plan, self.holder,
-                    index, shards)
-                for rid in last_ids:
-                    cnt = int(counts[rid]) if rid < counts.size else 0
-                    if cnt > 0:
-                        group = [FieldRow(fn, ri) for fn, ri in combo]
-                        group.append(FieldRow(last_field, rid))
-                        results.append(GroupCount(group, cnt))
-            results.sort(key=lambda g: tuple(
-                (fr.field, fr.row_id) for fr in g.group))
-            if limit is not None:
-                results = results[:limit]
-            return results
+            combos = list(prefix_combos())
+            if not combos:
+                return []
+            mat = np.asarray(
+                [[rid for _, rid in combo] for combo in combos],
+                dtype=np.int32).reshape(len(combos), len(prefix_fields))
+            # A handful of executable invocations cover every combo
+            # (vmapped combo axis, chunked to bound device memory) — the
+            # odometer's per-combo round trips (executor.go:3058) collapse
+            # into one dispatch per 256 combos, resolved by a single fetch
+            chunked = self.mesh_exec.group_counts_batch_async(
+                (last_field, VIEW_STANDARD), prefix_keys, mat, filter_plan,
+                self.holder, index, shards)
+            all_parts = [p for _, _, ps in chunked for p in ps]
+
+            def _fin(hp, combos=combos, last_ids=last_ids):
+                out: list[GroupCount] = []
+                i = 0
+                for lo, hi, ps in chunked:
+                    acc = None
+                    for p in hp[i: i + len(ps)]:
+                        a = np.asarray(p, dtype=np.int64)
+                        acc = a.copy() if acc is None else acc_counts(acc, a)
+                    i += len(ps)
+                    for ci in range(lo, hi):
+                        combo = combos[ci]
+                        for rid in last_ids:
+                            cnt = (int(acc[ci - lo, rid])
+                                   if acc is not None
+                                   and rid < acc.shape[1] else 0)
+                            if cnt > 0:
+                                group = [FieldRow(fn, ri)
+                                         for fn, ri in combo]
+                                group.append(FieldRow(last_field, rid))
+                                out.append(GroupCount(group, cnt))
+                out.sort(key=lambda g: tuple(
+                    (fr.field, fr.row_id) for fr in g.group))
+                return _paginate(out)
+
+            return _Pending(all_parts, _fin)
 
         filter_segs = None
         if filt_call is not None:
@@ -684,9 +805,7 @@ class Executor:
 
         results.sort(key=lambda g: tuple(
             (fr.field, fr.row_id) for fr in g.group))
-        if limit is not None:
-            results = results[:limit]
-        return results
+        return _paginate(results)
 
     # -- Options (executor.go executeOptionsCall) --------------------------
 

@@ -358,6 +358,9 @@ class PreparedCache:
                     "extra": {"field": f.name, "view": f.bsi_view_name(),
                               "base": f.options.base}}
         # TopN
+        from .executor import TOPN_EXTRAS
+        if any(k in c.args for k in TOPN_EXTRAS):
+            return None  # extras need extra device passes + attr reads
         field_name, ok = c.string_arg("_field")
         if not ok or ex.holder.field(index, field_name) is None:
             return None

@@ -130,6 +130,20 @@ class Pair:
         return d
 
 
+def acc_counts(acc, counts):
+    """Sum two count arrays whose LAST axis lengths differ (row capacities
+    vary across shards/groups; leading axes must match).  Mutates and
+    returns the longer one."""
+    import numpy as np
+    counts = np.asarray(counts, dtype=np.int64)
+    if counts.shape[-1] > acc.shape[-1]:
+        counts = counts.copy()
+        counts[..., : acc.shape[-1]] += acc
+        return counts
+    acc[..., : counts.shape[-1]] += counts
+    return acc
+
+
 def merge_pairs(pair_lists: list[list[Pair]]) -> list[Pair]:
     """Sum counts by id (executor.go:912 Pairs.Add reduce)."""
     acc: dict[int, int] = {}

@@ -27,6 +27,8 @@ class DeviceBudget:
         self._entries: OrderedDict[tuple, tuple[int, Callable[[], None]]] = \
             OrderedDict()
         self._total = 0
+        self._peak = 0
+        self.evictions = 0
         self._lock = threading.RLock()
 
     @property
@@ -49,9 +51,11 @@ class DeviceBudget:
                         self._total + nbytes > self.limit_bytes:
                     _, (freed, cb) = self._entries.popitem(last=False)
                     self._total -= freed
+                    self.evictions += 1
                     to_evict.append(cb)
             self._entries[key] = (nbytes, evict)
             self._total += nbytes
+            self._peak = max(self._peak, self._total)
         for cb in to_evict:
             try:
                 cb()
@@ -73,8 +77,10 @@ class DeviceBudget:
         with self._lock:
             return {
                 "residentBytes": self._total,
+                "peakBytes": self._peak,
                 "limitBytes": self.limit_bytes,
                 "entries": len(self._entries),
+                "evictions": self.evictions,
             }
 
 

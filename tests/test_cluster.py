@@ -206,6 +206,38 @@ def test_node_down_replica_retry(cluster3):
     assert len(topn) == 2
 
 
+def test_topn_tanimoto_matches_single_node(cluster3, tmp_path):
+    """Tanimoto must be computed on GLOBAL counts: a row split across
+    nodes would be kept/dropped differently under per-node filtering
+    (fragment.go:1704 semantics, finalized at the coordinator)."""
+    setup_index(cluster3)
+    # row 0 (src) and row 1 overlap heavily but their columns span many
+    # shards (nodes); row 2 overlaps little
+    src_cols = list(range(0, 6 * SHARD_WIDTH, SHARD_WIDTH // 2))  # 12 cols
+    r1_cols = src_cols[:10] + [7, 8]
+    r2_cols = src_cols[:3] + [100, 101, 102, 103, 104, 105]
+    rows, cols_ = [], []
+    for r, cs in [(0, src_cols), (1, r1_cols), (2, r2_cols)]:
+        rows += [r] * len(cs)
+        cols_ += cs
+    p0 = cluster3[0].port
+    _req(p0, "POST", "/index/ci/field/f/import",
+         {"rowIDs": rows, "columnIDs": cols_})
+    q = "TopN(f, Row(f=0), tanimotoThreshold=60)"
+    got = [query(s.port, "ci", q)[0] for s in cluster3]
+    # single-node oracle
+    from pilosa_tpu.storage import Holder
+    from pilosa_tpu.executor import Executor
+    h = Holder(None)
+    f1 = h.create_index("ci").create_field("f")
+    f1.import_bits(np.array(rows), np.array(cols_))
+    want = [{"id": p.id, "count": p.count}
+            for p in Executor(h).execute("ci", q)[0]]
+    assert want  # non-trivial
+    for g in got:
+        assert g == want
+
+
 def test_group_by_across_nodes(cluster3):
     setup_index(cluster3)
     _req(cluster3[0].port, "POST", "/index/ci/field/g", {})
@@ -240,6 +272,57 @@ def test_anti_entropy_repair(cluster3):
     frag = victim.holder.fragment("ci", "f", "standard", 2)
     assert frag is not None
     assert col % SHARD_WIDTH in frag.row_columns(4)
+
+
+def test_anti_entropy_majority_clear_and_push(tmp_path):
+    """mergeBlock parity (fragment.go:1875): a bit cleared on a majority
+    of replicas is CLEARED on the minority holder (not resurrected), and
+    repairs are PUSHED to disagreeing peers, not just pulled."""
+    servers = make_cluster(tmp_path, n=3, replica_n=3)
+    try:
+        setup_index(servers)
+        col = 9
+        query(servers[0].port, "ci", f"Set({col}, f=4)")
+        for s in servers:  # replica_n=3: every node holds the bit
+            assert s.holder.fragment("ci", "f", "standard", 0) is not None
+        # diverge: clear the bit directly on nodes 0 and 1 (majority clear)
+        for s in servers[:2]:
+            s.holder.fragment("ci", "f", "standard", 0).clear_bit(4, col)
+        # sync on a CLEAR-holding node: consensus=clear must push the
+        # clear to node2 (which still holds the bit) and not resurrect it
+        servers[0].cluster.sync_holder()
+        for s in servers:
+            frag = s.holder.fragment("ci", "f", "standard", 0)
+            assert col not in frag.row_columns(4), s.cluster.node_id
+        # now diverge the other way: bit set on majority, wiped on one
+        query(servers[0].port, "ci", f"Set({col + 1}, f=4)")
+        servers[2].holder.fragment("ci", "f", "standard", 0) \
+            .clear_bit(4, col + 1)
+        servers[0].cluster.sync_holder()  # push path: 0 repairs 2
+        frag2 = servers[2].holder.fragment("ci", "f", "standard", 0)
+        assert col + 1 in frag2.row_columns(4)
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_anti_entropy_attr_sync(cluster3):
+    """holder.go:1002-1096: attr stores sync by block diff — a replica
+    missing/stale on an attr converges to its peers on its own pass."""
+    setup_index(cluster3)
+    query(cluster3[0].port, "ci", "Set(1, f=2)")
+    # write an attr through the cluster (replicated), then diverge node1
+    query(cluster3[0].port, "ci", 'SetRowAttrs(f, 2, team="core")')
+    f1 = cluster3[1].holder.index("ci").field("f")
+    f1.row_attrs.set_attrs(2, {"team": "stale", "extra": None})
+    col_attrs = cluster3[1].holder.index("ci").column_attrs
+    col_attrs.set_attrs(7, {"ghost": True})
+    cluster3[1].cluster.sync_holder()
+    assert f1.row_attrs.attrs(2)["team"] == "core"
+    # column attrs flow the other way too: node0 pulls node1's id 7 attr
+    cluster3[0].cluster.sync_holder()
+    assert cluster3[0].holder.index("ci").column_attrs.attrs(7) == \
+        {"ghost": True}
 
 
 def test_write_fails_when_replica_down(cluster3):

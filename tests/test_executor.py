@@ -178,6 +178,47 @@ def test_topn_with_filter_and_ids(ex, holder):
     assert [(p.id, p.count) for p in pairs] == [(1, 2), (2, 1)]
 
 
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_topn_tanimoto(holder, use_mesh):
+    """fragment.go:1704 topBitmapPairs: keep rows whose tanimoto
+    coefficient vs the source row clears the threshold."""
+    # src = row 0 with cols {0..9}; row 1 = same 10 cols (tan=100);
+    # row 2 = 5 of them + 5 others (tan = 5/15 = 33%); row 3 disjoint
+    bits = [(0, c) for c in range(10)]
+    bits += [(1, c) for c in range(10)]
+    bits += [(2, c) for c in range(5, 15)]
+    bits += [(3, c) for c in range(100, 110)]
+    setup_set_field(holder, bits)
+    e = Executor(holder, use_mesh=use_mesh)
+    pairs = e.execute("i", "TopN(f, Row(f=0), tanimotoThreshold=50)")[0]
+    assert [(p.id, p.count) for p in pairs] == [(0, 10), (1, 10)]
+    pairs = e.execute("i", "TopN(f, Row(f=0), tanimotoThreshold=30)")[0]
+    assert [(p.id, p.count) for p in pairs] == [(0, 10), (1, 10), (2, 5)]
+    with pytest.raises(Exception, match="source row"):
+        e.execute("i", "TopN(f, tanimotoThreshold=50)")
+    with pytest.raises(Exception, match="tanimotoThreshold"):
+        e.execute("i", "TopN(f, Row(f=0), tanimotoThreshold=0)")
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_topn_attr_filter(holder, use_mesh):
+    """executor.go:942-995: attrName/attrValues restrict TopN to rows whose
+    row attribute matches."""
+    f = setup_set_field(holder, [
+        (0, 1), (0, 2), (1, 3), (2, 4), (2, 5), (2, 6)])
+    f.row_attrs.set_attrs(0, {"category": "tool"})
+    f.row_attrs.set_attrs(2, {"category": "lib"})
+    e = Executor(holder, use_mesh=use_mesh)
+    pairs = e.execute(
+        "i", 'TopN(f, attrName="category", attrValues=["tool", "lib"])')[0]
+    assert [(p.id, p.count) for p in pairs] == [(2, 3), (0, 2)]
+    pairs = e.execute(
+        "i", 'TopN(f, attrName="category", attrValues=["tool"])')[0]
+    assert [(p.id, p.count) for p in pairs] == [(0, 2)]
+    with pytest.raises(Exception, match="attrValues"):
+        e.execute("i", 'TopN(f, attrName="category")')
+
+
 # -- Rows -------------------------------------------------------------------
 
 def test_rows(ex, holder):
@@ -220,6 +261,32 @@ def test_group_by_with_filter_and_limit(ex, holder):
     # filter = col {2}
     as_tuples = [(g.group[0].row_id, g.count) for g in got]
     assert as_tuples == [(0, 1), (1, 1)]
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_group_by_previous_pagination(holder, use_mesh):
+    """executor.go:1403: previous=[...] resumes strictly after that group;
+    with limit it pages through the full result set."""
+    idx = holder.create_index("i")
+    fa = idx.create_field("a")
+    fb = idx.create_field("b")
+    fa.import_bits(np.array([0, 0, 1, 1]), np.array([1, 2, 1, 2]))
+    fb.import_bits(np.array([0, 1]), np.array([1, 2]))
+    e = Executor(holder, use_mesh=use_mesh)
+    full = e.execute("i", "GroupBy(Rows(a), Rows(b))")[0]
+    tuples = [tuple(fr.row_id for fr in g.group) for g in full]
+    assert tuples == [(0, 0), (0, 1), (1, 0), (1, 1)]
+    # page with limit=2 then resume from the last group of page 1
+    page1 = e.execute("i", "GroupBy(Rows(a), Rows(b), limit=2)")[0]
+    assert [tuple(fr.row_id for fr in g.group) for g in page1] == \
+        [(0, 0), (0, 1)]
+    page2 = e.execute(
+        "i", "GroupBy(Rows(a), Rows(b), limit=2, previous=[0, 1])")[0]
+    assert [tuple(fr.row_id for fr in g.group) for g in page2] == \
+        [(1, 0), (1, 1)]
+    assert [g.count for g in page2] == [g.count for g in full[2:]]
+    with pytest.raises(Exception, match="previous"):
+        e.execute("i", "GroupBy(Rows(a), Rows(b), previous=[1])")
 
 
 # -- writes -----------------------------------------------------------------

@@ -186,3 +186,32 @@ def test_keyed_cluster_roundtrip(tmp_path):
     finally:
         for s in servers:
             s.close()
+
+
+def test_remote_translate_batches_requests(tmp_path):
+    """N uncached keys/ids must translate in ONE coordinator POST, not N
+    (r2 advisor's last open finding)."""
+    from pilosa_tpu.parallel.cluster import RemoteTranslateStore
+
+    calls = []
+
+    class FakeClient:
+        def _json(self, host, method, path, body):
+            calls.append(body)
+            if "keys" in body:
+                return {"ids": [100 + i for i, _ in
+                                enumerate(body["keys"])]}
+            return {"keys": [f"k{i}" for i in body["ids"]]}
+
+    st = RemoteTranslateStore(FakeClient(), "h", "i", None)
+    ids = st.translate_keys(["a", "b", "c", "a"])
+    assert len(calls) == 1 and calls[0] == {"keys": ["a", "b", "c"]}
+    assert ids[0] == ids[3]
+    # cached now: no further requests
+    st.translate_keys(["a", "c"])
+    assert len(calls) == 1
+    # id -> key batches the uncached subset only
+    st.translate_ids([7, 8, ids[0]])
+    assert len(calls) == 2 and calls[1] == {"ids": [7, 8]}
+    st.translate_ids([7, 8])
+    assert len(calls) == 2
