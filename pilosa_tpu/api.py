@@ -17,6 +17,7 @@ from .core import SHARD_WIDTH
 from .executor import Executor
 from .pql import parse
 from .storage import FieldOptions, Holder
+from .utils.stats import StatsClient
 
 # Cluster states (cluster.go:47-50).
 STATE_STARTING = "STARTING"
@@ -57,9 +58,10 @@ class API:
         equivalent of the reference's worker pool + mapReduce
         (executor.go:80-110, 2455)."""
         self.holder = holder
-        self.executor = Executor(holder, use_mesh=use_mesh)
         self.cluster = cluster  # None = single-node
-        self.stats = stats
+        self.stats = stats if stats is not None else StatsClient()
+        self.executor = Executor(holder, use_mesh=use_mesh,
+                                 stats=self.stats)
         self._lock = threading.RLock()
 
     # -- state validation (api.go:119) -------------------------------------

@@ -108,13 +108,19 @@ def _resolve_pendings(results):
 
 
 class Executor:
-    def __init__(self, holder, mesh=None, use_mesh: bool | None = None):
+    def __init__(self, holder, mesh=None, use_mesh: bool | None = None,
+                 stats=None):
         """``mesh``: a jax Mesh to execute shard batches on (stacked
         shard_map execution with ICI reductions, parallel/mesh_exec.py).
         When None, per-shard dispatch is used.  ``use_mesh=True`` with no
-        mesh builds one over all local devices."""
+        mesh builds one over all local devices.  ``stats``: a StatsClient
+        for per-phase timings (parse/translate/dispatch/fetch) and cache
+        counters, surfaced at /debug/vars (the instrumentation sites of
+        executor.go:295-336)."""
         self.holder = holder
         self.compiler = PlanCompiler()
+        from ..utils.stats import NopStatsClient
+        self.stats = stats if stats is not None else NopStatsClient()
         from .translator import Translator
         self.translator = Translator(holder)
         self.mesh_exec = None
@@ -136,15 +142,21 @@ class Executor:
         """``translate=False`` for internal (already-translated) requests —
         the reference's opt.Remote skipping translateCalls
         (executor.go:147)."""
+        stats = self.stats
         if isinstance(query, str):
             if translate and self.prepared is not None:
-                hit, out = self.prepared.attempt(index_name, query, shards)
+                with stats.timer("query.prepared"):
+                    hit, out = self.prepared.attempt(index_name, query,
+                                                     shards)
                 if hit:
+                    stats.count("query.prepared.hit")
                     return out
+                stats.count("query.prepared.miss")
                 if out is not None:
                     query = out  # parsed (tagged) AST — don't parse twice
             if isinstance(query, str):
-                query = parse(query)
+                with stats.timer("query.parse"):
+                    query = parse(query)
         idx = self.holder.index(index_name)
         if idx is None:
             raise ExecutionError(f"index not found: {index_name}")
@@ -152,20 +164,23 @@ class Executor:
             # always runs: validates stray string keys even when no store
             # is enabled (executor.go:2658 "string 'col' value not
             # allowed...")
-            query = self.translator.translate_query(index_name, query)
+            with stats.timer("query.translate"):
+                query = self.translator.translate_query(index_name, query)
         if shards is None:
             shards = sorted(idx.available_shards())
         # Batched grouping reorders dispatch, which is only sound when no
         # call mutates state a later call could read — mixed write/read
         # queries run strictly sequentially like the reference.
-        if self.mesh_exec is not None and len(query.calls) > 1 and \
-                not any(c.name in WRITE_CALLS for c in query.calls):
-            results = self._execute_calls_grouped(index_name, query.calls,
-                                                  shards)
-        else:
-            results = [self._execute_call(index_name, c, shards)
-                       for c in query.calls]
-        results = _resolve_pendings(results)
+        with stats.timer("query.dispatch"):
+            if self.mesh_exec is not None and len(query.calls) > 1 and \
+                    not any(c.name in WRITE_CALLS for c in query.calls):
+                results = self._execute_calls_grouped(index_name,
+                                                      query.calls, shards)
+            else:
+                results = [self._execute_call(index_name, c, shards)
+                           for c in query.calls]
+        with stats.timer("query.fetch"):
+            results = _resolve_pendings(results)
         if translate and self.translator.needs_translation(index_name):
             results = self.translator.translate_results(
                 index_name, query.calls, results)

@@ -56,6 +56,7 @@ NODE_DOWN = "DOWN"
 STATE_STARTING = "STARTING"
 STATE_NORMAL = "NORMAL"
 STATE_DEGRADED = "DEGRADED"
+STATE_RESIZING = "RESIZING"
 
 
 class ClusterError(RuntimeError):
@@ -124,10 +125,11 @@ class InternalClient:
 
     def _request(self, host: str, method: str, path: str,
                  body: bytes | None = None,
-                 ctype: str = "application/json") -> tuple[int, bytes]:
+                 ctype: str = "application/json",
+                 timeout: float | None = None) -> tuple[int, bytes]:
         h, _, p = host.rpartition(":")
         conn = http.client.HTTPConnection(h or "localhost", int(p),
-                                          timeout=self.timeout)
+                                          timeout=timeout or self.timeout)
         try:
             headers = {"Content-Type": ctype,
                        "Content-Length": str(len(body or b""))}
@@ -137,9 +139,10 @@ class InternalClient:
         finally:
             conn.close()
 
-    def _json(self, host, method, path, obj=None):
+    def _json(self, host, method, path, obj=None, timeout=None):
         body = None if obj is None else json.dumps(obj).encode()
-        status, data = self._request(host, method, path, body)
+        status, data = self._request(host, method, path, body,
+                                     timeout=timeout)
         if status >= 400:
             try:
                 msg = json.loads(data).get("error", data.decode())
@@ -162,9 +165,13 @@ class InternalClient:
         })
         return result_from_wire(out["result"])
 
-    def send_message(self, host: str, msg: dict):
-        """(broadcast.go SendTo -> POST /internal/cluster/message)"""
-        self._json(host, "POST", "/internal/cluster/message", msg)
+    def send_message(self, host: str, msg: dict,
+                     timeout: float | None = None):
+        """(broadcast.go SendTo -> POST /internal/cluster/message).
+        ``timeout`` overrides the default 30 s for long-running messages
+        (a resize-fetch copies whole fragment sets inside one POST)."""
+        self._json(host, "POST", "/internal/cluster/message", msg,
+                   timeout=timeout)
 
     def import_local(self, host: str, index: str, field: str, payload: dict):
         """Forward a pre-grouped import batch to a shard owner
@@ -212,6 +219,15 @@ class InternalClient:
         out = self._json(host, "POST", "/internal/attr/diff", {
             "index": index, "field": field, "blocks": blocks_hex})
         return {int(k): v for k, v in out.get("attrs", {}).items()}
+
+    def fragment_list(self, host: str, index: str,
+                      shard: int) -> list[tuple[str, str]]:
+        """(field, view) fragments a node holds for (index, shard) — the
+        discovery step of a resize fetch."""
+        out = self._json(host, "GET",
+                         f"/internal/fragment/list?index={index}"
+                         f"&shard={shard}")
+        return [(f, v) for f, v in out.get("fragments", [])]
 
     def fragment_data(self, host: str, index: str, field: str, view: str,
                       shard: int) -> bytes:
@@ -355,6 +371,7 @@ class Cluster:
         self.health_interval = health_interval
         self._closing = threading.Event()
         self._health_thread = None
+        self._resize_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * len(self.nodes)))
 
@@ -420,7 +437,7 @@ class Cluster:
         self._update_state()
 
     def _update_state(self):
-        if self.state == STATE_STARTING:
+        if self.state in (STATE_STARTING, STATE_RESIZING):
             return
         down = any(n.state == NODE_DOWN for n in self.nodes)
         self.state = STATE_DEGRADED if down else STATE_NORMAL
@@ -809,6 +826,15 @@ class Cluster:
                     idx.delete_field(msg["field"])
                 except ValueError:
                     pass
+        elif t == "set-state":
+            # coordinator-driven state transition (resize begin/abort —
+            # cluster.go:1116 setStateAndBroadcast)
+            self.state = msg["state"]
+            self._update_state()
+        elif t == "resize-fetch":
+            self._apply_resize_fetch(msg)
+        elif t == "resize-complete":
+            self._apply_resize_complete(msg)
         else:
             raise ClusterError(f"unknown cluster message type {t!r}")
 
@@ -1048,6 +1074,220 @@ class Cluster:
             if attrs:
                 store.set_bulk_attrs(attrs)
 
+    # -- elasticity: checkpoint resharding (cluster.go:1196-1561) ----------
+    #
+    # The reference resizes live via coordinator-computed ResizeInstructions
+    # driven by gossip membership events.  The TPU-native design (SURVEY
+    # §5.8) reshapes a STATIC membership instead: an operator request tells
+    # the coordinator the new node list; the coordinator drives a
+    # two-phase protocol over plain HTTP:
+    #   phase 1 "resize-fetch":    every surviving node copies the
+    #       fragments it will own under the NEW placement but lacks,
+    #       sourced from a current owner (full-fragment checkpoint copy via
+    #       /internal/fragment/data — fragment.go:1297
+    #       followResizeInstruction's RetrieveShardFromURI).  Old placement
+    #       stays live for queries throughout.
+    #   phase 2 "resize-complete": every node atomically adopts the new
+    #       membership/placement and garbage-collects fragments it no
+    #       longer owns (holder.go:1131 holderCleaner).
+    # No node drops data before every node has fetched, so a crash mid-
+    # resize leaves a superset of the needed data and the operation can be
+    # retried.
+
+    def _membership(self) -> list[dict]:
+        return [{"id": n.id, "uri": n.host} for n in self.nodes]
+
+    def resize_add_node(self, node_id: str, host: str):
+        """(api.go:1226-ish AddNode analog; coordinator only)"""
+        if not self.is_coordinator:
+            raise ClusterError("resize must be requested on the coordinator")
+        if node_id in self.by_id:
+            raise ClusterError(f"node {node_id!r} already in cluster")
+        new = self._membership() + [{"id": node_id, "uri": host}]
+        self._run_resize(new)
+
+    def resize_remove_node(self, node_id: str):
+        """(api.go:1226 RemoveNode; coordinator only)"""
+        if not self.is_coordinator:
+            raise ClusterError("resize must be requested on the coordinator")
+        if node_id == self.node_id:
+            raise ClusterError("cannot remove the coordinator")
+        if node_id not in self.by_id:
+            raise ClusterError(f"unknown node {node_id!r}")
+        new = [m for m in self._membership() if m["id"] != node_id]
+        self._run_resize(new)
+
+    # resize-fetch can copy whole fragment sets inside one message POST
+    RESIZE_FETCH_TIMEOUT = 600.0
+
+    def _run_resize(self, new_membership: list[dict]):
+        if not self._resize_lock.acquire(blocking=False):
+            raise ClusterError("a resize is already in progress")
+        try:
+            if self.state not in (STATE_NORMAL, STATE_DEGRADED):
+                raise ClusterError(
+                    f"cannot resize in state {self.state}")
+            self._run_resize_locked(new_membership)
+        finally:
+            self._resize_lock.release()
+
+    def _run_resize_locked(self, new_membership: list[dict]):
+        old_placement = self.placement
+        new_ids = [m["id"] for m in new_membership]
+        new_placement = Placement(new_ids, replica_n=self.replica_n,
+                                  hasher=self.placement.hasher)
+        hosts = {m["id"]: m["uri"] for m in new_membership}
+        removed = [n for n in self.nodes if n.id not in hosts]
+        # every participant (old members + joiners) blocks writes while
+        # fragments are in flight; an aborted resize restores NORMAL below
+        participants = {n.id: n.host for n in self.nodes}
+        participants.update(hosts)
+        for nid, host in participants.items():
+            if nid != self.node_id:
+                try:
+                    self.client.send_message(
+                        host, {"type": "set-state",
+                               "state": STATE_RESIZING})
+                except Exception:
+                    pass  # DOWN old member; fetch sources skip it anyway
+        self.state = STATE_RESIZING
+        completed = False
+        try:
+            # per-node fetch lists: (index, shard) pairs the node will own
+            # under the new placement but does not own now, with a current
+            # owner as source (cluster.go:784 fragSources)
+            fetches: dict[str, list[dict]] = {nid: [] for nid in new_ids}
+            for index_name in list(self.holder.indexes):
+                for s in self._available_shards(index_name):
+                    old_owners = old_placement.shard_nodes(index_name, s)
+                    ready_sources = [
+                        o for o in old_owners
+                        if o == self.node_id
+                        or self.by_id[o].state == NODE_READY]
+                    if not ready_sources:
+                        raise ClusterError(
+                            f"no live source for shard {s} of "
+                            f"{index_name!r}")
+                    src_host = self.by_id[ready_sources[0]].host
+                    for nid in new_placement.shard_nodes(index_name, s):
+                        if nid not in old_owners:
+                            fetches[nid].append({
+                                "index": index_name, "shard": s,
+                                "source": src_host})
+            schema = self.holder.schema()
+            # phase 1: everyone fetches (parallel, all must succeed)
+            futs = []
+            for nid in new_ids:
+                msg = {"type": "resize-fetch", "fetch": fetches[nid],
+                       "schema": schema}
+                if nid == self.node_id:
+                    self.handle_message(msg)
+                else:
+                    futs.append(self._pool.submit(
+                        self.client.send_message, hosts[nid], msg,
+                        self.RESIZE_FETCH_TIMEOUT))
+            for f in futs:
+                f.result()  # any fetch failure aborts before data loss
+            # phase 2: everyone switches placement + cleans
+            done_msg = {"type": "resize-complete",
+                        "membership": new_membership,
+                        "replicaN": self.replica_n}
+            futs = [self._pool.submit(self.client.send_message,
+                                      hosts[nid], done_msg)
+                    for nid in new_ids if nid != self.node_id]
+            self.handle_message(done_msg)
+            for f in futs:
+                f.result()
+            completed = True
+            # a gracefully removed node reverts to a single-node cluster
+            # view of itself; best-effort notification
+            for n in removed:
+                try:
+                    self.client.send_message(n.host, {
+                        "type": "resize-complete",
+                        "membership": [{"id": n.id, "uri": n.host}],
+                        "replicaN": 1})
+                except Exception:
+                    pass
+        finally:
+            if not completed:
+                # abort: restore every participant to NORMAL under the OLD
+                # membership — no node dropped data in phase 1, so the
+                # cluster simply resumes and the resize can be retried
+                for nid, host in participants.items():
+                    if nid != self.node_id:
+                        try:
+                            self.client.send_message(
+                                host, {"type": "set-state",
+                                       "state": STATE_NORMAL})
+                        except Exception:
+                            pass
+            if self.state == STATE_RESIZING:
+                self.state = STATE_NORMAL
+                self._update_state()
+
+    def _apply_resize_fetch(self, msg: dict):
+        """Phase 1: copy fragments this node will own but lacks.  State is
+        driven by the coordinator's set-state / resize-complete messages,
+        not here — a node must not latch RESIZING it cannot exit."""
+        from ..storage.roaring_io import unpack_roaring
+
+        self.handle_message({"type": "apply-schema",
+                             "schema": msg.get("schema", [])})
+        for item in msg.get("fetch", []):
+            index, shard, src = item["index"], item["shard"], item["source"]
+            try:
+                frag_list = self.client.fragment_list(src, index, shard)
+            except Exception as e:
+                raise ClusterError(
+                    f"resize fetch: cannot list fragments of shard "
+                    f"{shard} from {src}: {e}")
+            idx = self.holder.index(index)
+            for field, view in frag_list:
+                f = idx.field(field)
+                if f is None:
+                    continue
+                blob = self.client.fragment_data(src, index, field, view,
+                                                 shard)
+                rows, cols = unpack_roaring(blob, self.holder.max_row_id)
+                frag = f._create_view_if_not_exists(view) \
+                    .create_fragment_if_not_exists(shard)
+                frag.bulk_import(rows, cols)
+
+    def _apply_resize_complete(self, msg: dict):
+        """Phase 2: adopt the new membership and GC unowned fragments."""
+        membership = msg["membership"]
+        self.replica_n = msg.get("replicaN", self.replica_n)
+        if self.node_id not in {m["id"] for m in membership}:
+            # we were removed; keep serving a single-node view of ourselves
+            membership = [{"id": self.node_id, "uri": self.local.host}]
+        old_states = {n.id: n.state for n in self.nodes}
+        self.nodes = [Node(m["id"], m["uri"]) for m in membership]
+        for n in self.nodes:
+            n.state = old_states.get(n.id, NODE_READY)
+        self.by_id = {n.id: n for n in self.nodes}
+        self.placement = Placement([n.id for n in self.nodes],
+                                   replica_n=self.replica_n,
+                                   hasher=self.placement.hasher)
+        self._holder_cleaner()
+        self.state = STATE_NORMAL
+        self._update_state()
+
+    def _holder_cleaner(self):
+        """Drop fragments this node no longer owns under the current
+        placement (holder.go:1131 holderCleaner)."""
+        for index_name, idx in list(self.holder.indexes.items()):
+            for f in list(idx.fields.values()):
+                for v in list(f.views.values()):
+                    for shard in list(v.fragments):
+                        if self.node_id not in self.placement.shard_nodes(
+                                index_name, shard):
+                            frag = v.fragments.pop(shard)
+                            try:
+                                frag.close()
+                            except Exception:
+                                pass
+
     # -- internal HTTP routes (handler.go:302-314 /internal/*) -------------
 
     def register_routes(self, router):
@@ -1195,3 +1435,31 @@ class Cluster:
             return ("application/octet-stream", pack_roaring(rows, cols))
 
         router.add("GET", "/internal/fragment/data", fragment_data)
+
+        def fragment_list(req, args):
+            index = req.query.get("index", [""])[0]
+            shard = int(req.query.get("shard", ["0"])[0])
+            out = []
+            idx = cluster.holder.index(index)
+            if idx is not None:
+                for fname, f in idx.fields.items():
+                    for vname, v in f.views.items():
+                        if v.fragment(shard) is not None:
+                            out.append([fname, vname])
+            return {"fragments": out}
+
+        router.add("GET", "/internal/fragment/list", fragment_list)
+
+        def resize_add_node(req, args):
+            body = req.json()
+            cluster.resize_add_node(body["id"], body["host"])
+            return {"nodes": cluster.node_statuses()}
+
+        router.add("POST", "/cluster/resize/add-node", resize_add_node)
+
+        def resize_remove_node(req, args):
+            body = req.json()
+            cluster.resize_remove_node(body["id"])
+            return {"nodes": cluster.node_statuses()}
+
+        router.add("POST", "/cluster/resize/remove-node", resize_remove_node)

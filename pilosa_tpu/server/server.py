@@ -15,7 +15,6 @@ import threading
 from ..api import API
 from ..storage import Holder
 from ..utils.logger import Logger
-from ..utils.stats import StatsClient
 from .handler import make_http_server
 
 
@@ -38,9 +37,11 @@ class Config:
     # blocks (storage/membudget.py DeviceBudget — the syswrap map-cap
     # analog, syswrap/mmap.go:46).  0 = unlimited (accounting only).
     device_budget_mb: int = 0
-    # monitors
+    # monitors / metrics (reference server/config.go metric section)
     anti_entropy_interval: float = 600.0
     metric_poll_interval: float = 60.0
+    metric_service: str = "expvar"  # expvar | statsd | none
+    metric_host: str = "localhost:8125"
     verbose: bool = False
 
     @classmethod
@@ -65,6 +66,8 @@ class Config:
             "PILOSA_TPU_MAX_ROW_ID": ("max_row_id", int),
             "PILOSA_TPU_USE_MESH": ("use_mesh", lambda s: s != "false"),
             "PILOSA_TPU_DEVICE_BUDGET_MB": ("device_budget_mb", int),
+            "PILOSA_TPU_METRIC_SERVICE": ("metric_service", str),
+            "PILOSA_TPU_METRIC_HOST": ("metric_host", str),
         }
         for env, (attr, conv) in env_map.items():
             if env in os.environ:
@@ -108,7 +111,9 @@ class Server:
     def __init__(self, config: Config | None = None):
         self.config = config or Config()
         self.logger = Logger(verbose=self.config.verbose)
-        self.stats = StatsClient()
+        from ..utils.stats import make_stats_client
+        self.stats = make_stats_client(self.config.metric_service,
+                                       self.config.metric_host)
         # The budget is process-wide; the most recent Server's config wins
         # (0 restores unlimited — a stale limit from an earlier instance in
         # the same process must not outlive its config).
@@ -170,6 +175,42 @@ class Server:
                                  daemon=True)
             t.start()
             self._threads.append(t)
+        if self.config.metric_poll_interval > 0:
+            t = threading.Thread(target=self._monitor_runtime, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def collect_runtime_stats(self):
+        """Process-level gauges (server.go:813 monitorRuntime + gopsutil;
+        /proc in place of gopsutil, gc module in place of MemStats)."""
+        import gc as _gc
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        self.stats.gauge("runtime.rss_bytes",
+                                         int(line.split()[1]) * 1024)
+                        break
+        except OSError:
+            pass
+        try:
+            self.stats.gauge("runtime.open_fds",
+                             len(os.listdir("/proc/self/fd")))
+        except OSError:
+            pass
+        self.stats.gauge("runtime.threads", threading.active_count())
+        g0, g1, g2 = _gc.get_count()
+        self.stats.gauge("runtime.gc_gen0", g0)
+        from ..storage.membudget import DEFAULT_BUDGET
+        self.stats.gauge("runtime.hbm_resident_bytes",
+                         DEFAULT_BUDGET.resident_bytes)
+
+    def _monitor_runtime(self):
+        while not self._closing.wait(self.config.metric_poll_interval):
+            try:
+                self.collect_runtime_stats()
+            except Exception:
+                pass
 
     def _monitor_anti_entropy(self):
         """(server.go:514 monitorAntiEntropy)"""

@@ -48,7 +48,10 @@ def unpack_roaring(data: bytes, row_id_cap: int | None = None
     process-wide DEFAULT_MAX_ROW_ID)."""
     try:
         return _unpack_roaring(data, row_id_cap)
-    except (struct.error, IndexError, OverflowError) as e:
+    except RoaringFormatError:
+        raise
+    except (struct.error, IndexError, OverflowError, ValueError) as e:
+        # ValueError: np.frombuffer on a truncated payload
         raise RoaringFormatError(f"malformed roaring data: {e}")
 
 

