@@ -22,7 +22,7 @@ from ..storage import time_quantum as tq
 from .plan import PlanCompiler, PlanError, Resolver, parametrize
 from .results import (
     FieldRow, GroupCount, Pair, RowIdentifiers, RowResult, ValCount,
-    acc_counts, sort_pairs,
+    acc_counts, rank_counts, sort_pairs,
 )
 
 BITMAP_CALLS = {"Row", "Range", "Intersect", "Union", "Difference", "Xor",
@@ -276,14 +276,7 @@ class Executor:
                 def _topn_fin(hp, b, ids, n):
                     counts = self.mesh_exec.merge_counts(
                         [p[b] for p in hp])
-                    if ids:
-                        pairs = [Pair(int(i), int(counts[i]))
-                                 for i in ids if i < counts.size]
-                    else:
-                        nz = np.nonzero(counts)[0]
-                        pairs = [Pair(int(i), int(counts[i])) for i in nz]
-                    pairs = [p for p in pairs if p.count > 0]
-                    return sort_pairs(pairs, n or None)
+                    return rank_counts(counts, n or None, ids)
 
                 for b, i in enumerate(idxs):
                     d = descs[i]
@@ -339,7 +332,18 @@ class Executor:
 
     def _execute_bitmap(self, index: str, c: Call, shards) -> RowResult:
         plan = self._resolve(index, c)
-        return RowResult(self._plan_segments(plan, index, shards))
+        attrs = None
+        if c.name in ("Row", "Range"):
+            # a plain Row() result carries its row's attributes
+            # (executor.go:651 executeBitmapCallShard -> row.Attrs)
+            fa = c.field_arg()
+            if fa is not None and isinstance(fa[1], int) \
+                    and not isinstance(fa[1], bool):
+                f = self.holder.field(index, fa[0])
+                if f is not None:
+                    attrs = f.row_attrs.attrs(fa[1]) or None
+        return RowResult(self._plan_segments(plan, index, shards),
+                         attrs=attrs)
 
     def _plan_segments(self, plan, index: str, shards) -> dict:
         if self.mesh_exec is not None:
@@ -515,19 +519,13 @@ class Executor:
             denom = t_ + src_count - c_
             ok = (denom > 0) & (100 * c_ >= tan_thresh * denom)
             counts = np.where(ok, c_, 0)
-        if ids:
-            pairs = [Pair(int(i), int(counts[i]))
-                     for i in ids if i < counts.size]
-        else:
-            nz = np.nonzero(counts)[0]
-            pairs = [Pair(int(i), int(counts[i])) for i in nz]
-        pairs = [p for p in pairs if p.count > 0]
-        if attr_name is not None:
-            allowed = set(attr_values)
-            pairs = [p for p in pairs
-                     if field.row_attrs.attrs(p.id).get(attr_name)
-                     in allowed]
-        return sort_pairs(pairs, n or None)
+        if attr_name is None:
+            # vectorized rank: only the returned n rows materialize Pairs
+            return rank_counts(counts, n or None, ids)
+        allowed = set(attr_values)
+        pairs = [p for p in rank_counts(counts, None, ids)
+                 if field.row_attrs.attrs(p.id).get(attr_name) in allowed]
+        return pairs[: n or None]
 
     def _execute_topn(self, index: str, c: Call, shards) -> list[Pair]:
         field_name, ok = c.string_arg("_field")
@@ -824,7 +822,37 @@ class Executor:
 
     # -- Options (executor.go executeOptionsCall) --------------------------
 
+    @staticmethod
+    def _options_bool(c: Call, name: str) -> bool:
+        v = c.args.get(name, False)
+        if not isinstance(v, bool):
+            raise ExecutionError(f"Options() {name} must be a bool")
+        return v
+
+    @staticmethod
+    def attach_column_attrs(holder, index: str, result):
+        """Stash [{"id", "attrs"}] for every result column that has column
+        attributes onto the RowResult; the HTTP layer lifts them to the
+        response's top-level "columnAttrs" (executor.go:163-192,
+        :209 readColumnAttrSets)."""
+        if not isinstance(result, RowResult):
+            return result
+        idx = holder.index(index)
+        # one store snapshot + intersect: O(stored attrs), not O(result
+        # columns) — results can span millions of columns
+        all_attrs = idx.column_attrs.all()
+        if not all_attrs:
+            result.column_attrs = []
+            return result
+        attr_ids = np.fromiter(all_attrs.keys(), dtype=np.int64,
+                               count=len(all_attrs))
+        have = np.intersect1d(attr_ids, result.columns())
+        result.column_attrs = [{"id": int(c), "attrs": all_attrs[int(c)]}
+                               for c in np.sort(have)]
+        return result
+
     def _execute_options(self, index: str, c: Call, shards):
+        """(executor.go:340-403 executeOptionsCall)"""
         if len(c.children) != 1:
             raise ExecutionError("Options() requires exactly one child")
         if "shards" in c.args:
@@ -832,7 +860,30 @@ class Executor:
             if not isinstance(arg, list):
                 raise ExecutionError("Options() shards must be a list")
             shards = [int(s) for s in arg]
-        return self._execute_call(index, c.children[0], shards)
+        column_attrs = self._options_bool(c, "columnAttrs")
+        exclude_row_attrs = self._options_bool(c, "excludeRowAttrs")
+        exclude_columns = self._options_bool(c, "excludeColumns")
+        result = self._execute_call(index, c.children[0], shards)
+        if not (column_attrs or exclude_row_attrs or exclude_columns):
+            return result
+
+        def _shape(r):
+            if isinstance(r, RowResult):
+                if exclude_columns:
+                    r.segments = {}
+                if column_attrs:
+                    # after excludeColumns on purpose: both flags yield no
+                    # attr sets, matching the reference's response shaping
+                    self.attach_column_attrs(self.holder, index, r)
+                if exclude_row_attrs:
+                    r.attrs = {}
+            return r
+
+        if isinstance(result, _Pending):
+            inner_fin = result.fin
+            result.fin = lambda hp: _shape(inner_fin(hp))
+            return result
+        return _shape(result)
 
     # -- writes (executor.go:2067 executeSet etc.) -------------------------
 

@@ -49,7 +49,7 @@ from ..ops import bsi
 from ..pql import parse
 from ..pql.ast import LitInt, Query
 from .plan import Resolver, parametrize
-from .results import Pair, ValCount, sort_pairs
+from .results import ValCount, rank_counts
 
 # Integer literals only: quoted strings and bare timestamps pass through
 # unchanged (they stay part of the template).  The lookaround classes keep
@@ -198,14 +198,7 @@ class PreparedEntry:
 
                 def _topn_fin(hp, b, ids, n):
                     counts = mesh.merge_counts([p[b] for p in hp])
-                    if ids:
-                        pairs = [Pair(int(i), int(counts[i]))
-                                 for i in ids if i < counts.size]
-                    else:
-                        nz = np.nonzero(counts)[0]
-                        pairs = [Pair(int(i), int(counts[i])) for i in nz]
-                    pairs = [p for p in pairs if p.count > 0]
-                    return sort_pairs(pairs, n or None)
+                    return rank_counts(counts, n or None, ids)
 
                 for b, i in enumerate(g.call_idxs):
                     results[i] = _Pending(

@@ -21,7 +21,10 @@ class RowResult:
                  keys: list[str] | None = None, attrs: dict | None = None):
         self.segments = segments or {}   # shard -> uint32[W] (jnp or np)
         self.keys = keys or []
-        self.attrs = attrs or {}
+        self.attrs = attrs or {}         # row attrs (row.go Row.Attrs)
+        # [{"id", "attrs"}] filled by Options(columnAttrs=true); lifted to
+        # the response's top-level "columnAttrs" by the HTTP layer
+        self.column_attrs: list = []
 
     # -- algebra (row.go:67-260) ------------------------------------------
 
@@ -157,6 +160,29 @@ def sort_pairs(pairs: list[Pair], n: int | None = None) -> list[Pair]:
     """Descending by count, ascending id tiebreak (pilosa.go Pairs.Sort)."""
     out = sorted(pairs, key=lambda p: (-p.count, p.id))
     return out[:n] if n else out
+
+
+def rank_counts(counts, n: int | None = None, ids=None) -> list[Pair]:
+    """Vectorized TopN ranking over a per-row count vector: nonzero (or
+    ``ids``-selected) rows sorted by (-count, id), materializing Pair
+    objects only for the returned n — the fragment.top/rankCache
+    replacement must not build a Python object per nonzero row at 50k-row
+    cache scale (fragment.go:1570, cache.go:136)."""
+    import numpy as np
+    counts = np.asarray(counts)
+    if ids:  # empty ids list = no filter (fragment.go:1618 len check)
+        sel = np.asarray([i for i in ids if 0 <= i < counts.size],
+                         dtype=np.int64)
+        vals = counts[sel] if sel.size else np.zeros(0, counts.dtype)
+        keep = vals > 0
+        nz, vals = sel[keep], vals[keep]
+    else:
+        nz = np.nonzero(counts)[0]
+        vals = counts[nz]
+    order = np.lexsort((nz, -vals))
+    if n:
+        order = order[:n]
+    return [Pair(int(i), int(c)) for i, c in zip(nz[order], vals[order])]
 
 
 @dataclass

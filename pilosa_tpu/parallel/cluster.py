@@ -82,8 +82,11 @@ def _seg_from_wire(s: str) -> np.ndarray:
 
 def result_to_wire(r) -> dict:
     if isinstance(r, RowResult):
-        return {"t": "row", "segments": {
+        out = {"t": "row", "segments": {
             str(s): _seg_to_wire(seg) for s, seg in r.segments.items()}}
+        if r.attrs:
+            out["attrs"] = r.attrs
+        return out
     if isinstance(r, ValCount):
         return {"t": "valcount", "val": r.val, "count": r.count}
     if isinstance(r, RowIdentifiers):
@@ -102,7 +105,8 @@ def result_from_wire(d: dict):
     t = d.get("t")
     if t == "row":
         return RowResult({int(s): _seg_from_wire(w)
-                          for s, w in d["segments"].items()})
+                          for s, w in d["segments"].items()},
+                         attrs=d.get("attrs"))
     if t == "valcount":
         return ValCount(d["val"], d["count"])
     if t == "rowids":
@@ -505,10 +509,36 @@ class Cluster:
             return self._execute_all_nodes_write(index, c, shards)
         if c.name in ("SetRowAttrs", "SetColumnAttrs"):
             return self._execute_attr_write(index, c)
-        if c.name == "Options" and "shards" in c.args:
-            pinned = [int(s) for s in c.args["shards"]]
-            return self._execute_call(index, c.children[0], pinned)
+        if c.name == "Options":
+            return self._execute_options(index, c, shards)
         return self._execute_read(index, c, shards)
+
+    def _execute_options(self, index: str, c: Call, shards: list[int]):
+        """Unwrap Options at the coordinator: fan out the CHILD call (so
+        per-call reduce semantics — Count sum, ValCount add, TopN
+        n-stripping — apply to the real call, not the wrapper) and shape
+        the merged result here (executor.go:340-403; attr stores are
+        replicated on every node)."""
+        from ..executor.executor import Executor
+
+        if len(c.children) != 1:
+            raise ClusterError("Options() requires exactly one child")
+        if "shards" in c.args:
+            if not isinstance(c.args["shards"], list):
+                raise ClusterError("Options() shards must be a list")
+            shards = [int(s) for s in c.args["shards"]]
+        exclude_columns = Executor._options_bool(c, "excludeColumns")
+        column_attrs = Executor._options_bool(c, "columnAttrs")
+        exclude_row_attrs = Executor._options_bool(c, "excludeRowAttrs")
+        result = self._execute_call(index, c.children[0], shards)
+        if isinstance(result, RowResult):
+            if exclude_columns:
+                result.segments = {}
+            if column_attrs:
+                Executor.attach_column_attrs(self.holder, index, result)
+            if exclude_row_attrs:
+                result.attrs = {}
+        return result
 
     def _local_exec(self, index: str, c: Call, shards: list[int]):
         return self.api.executor.execute(index, Query([c]), shards,
@@ -592,7 +622,7 @@ class Cluster:
         exclude: set[str] = set()
         pending = list(shards)
         if not pending:
-            return self._reduce(c, [self._local_exec(index, send, [])])
+            return self._reduce(index, c, [self._local_exec(index, send, [])])
         for _attempt in range(len(self.nodes) + 1):
             if not pending and results:
                 break
@@ -625,7 +655,7 @@ class Cluster:
         if pending:
             raise ClusterError(
                 f"no replicas available for shards {pending} of {index!r}")
-        return self._reduce(c, results)
+        return self._reduce(index, c, results)
 
     # -- writes ------------------------------------------------------------
 
@@ -713,7 +743,7 @@ class Cluster:
 
     # -- reduce (executor.go:2482 reduce fns per call type) ----------------
 
-    def _reduce(self, c: Call, results: list[Any]):
+    def _reduce(self, index: str, c: Call, results: list[Any]):
         results = [r for r in results if r is not None]
         if not results:
             return None
@@ -723,9 +753,11 @@ class Cluster:
             return sum(int(r) for r in results)
         if isinstance(first, RowResult):
             segments = {}
+            attrs = {}
             for r in results:
                 segments.update(r.segments)
-            return RowResult(segments)
+                attrs = attrs or r.attrs  # row attrs replicated per node
+            return RowResult(segments, attrs=attrs or None)
         if isinstance(first, ValCount):
             acc = first
             for r in results[1:]:

@@ -129,7 +129,14 @@ def build_router(api: API, server=None) -> Router:
         if "shards" in req.query:
             shards = [int(s) for s in req.query["shards"][0].split(",")]
         results = api.query(args["index"], query, shards)
-        return {"results": [serialize_result(x) for x in results]}
+        out = {"results": [serialize_result(x) for x in results]}
+        col_attrs = []
+        for r in results:
+            col_attrs.extend(getattr(r, "column_attrs", []))
+        if col_attrs:
+            # top-level ColumnAttrSets (http/response.go QueryResponse)
+            out["columnAttrs"] = col_attrs
+        return out
 
     r.add("POST", "/index/{index}/query", post_query)
 

@@ -206,6 +206,39 @@ def test_node_down_replica_retry(cluster3):
     assert len(topn) == 2
 
 
+def test_options_wrapped_aggregates_reduce_correctly(cluster3):
+    """Options(...) must reduce by its CHILD call's semantics across
+    nodes: Count sums, Sum adds, TopN merges with n applied globally."""
+    setup_index(cluster3)
+    rng = np.random.default_rng(11)
+    cols = rng.choice(6 * SHARD_WIDTH, size=1200, replace=False)
+    rows = rng.integers(0, 4, size=1200)
+    p0 = cluster3[0].port
+    _req(p0, "POST", "/index/ci/field/f/import",
+         {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()})
+    _req(p0, "POST", "/index/ci/field/v/import",
+         {"columnIDs": cols.tolist(),
+          "values": [int(v) for v in rng.integers(0, 1000, size=1200)]})
+    for srv in cluster3:
+        [plain] = query(srv.port, "ci", "Count(Row(f=1))")
+        [wrapped] = query(srv.port, "ci", "Options(Count(Row(f=1)))")
+        assert wrapped == plain == int((rows == 1).sum())
+        [s_plain] = query(srv.port, "ci", "Sum(field=v)")
+        [s_wrapped] = query(srv.port, "ci", "Options(Sum(field=v))")
+        assert s_wrapped == s_plain
+        [t_plain] = query(srv.port, "ci", "TopN(f, n=2)")
+        [t_wrapped] = query(srv.port, "ci", "Options(TopN(f, n=2))")
+        assert t_wrapped == t_plain and len(t_wrapped) == 2
+    # shaping flags still honored together with shards pinning
+    col = int(cols[rows == 1][0])
+    _req(p0, "POST", "/index/ci/query",
+         f'SetColumnAttrs({col}, tier="gold")')
+    out = _req(p0, "POST", "/index/ci/query",
+               f"Options(Row(f=1), columnAttrs=true, "
+               f"shards=[{col // SHARD_WIDTH}])")
+    assert out["columnAttrs"] == [{"id": col, "attrs": {"tier": "gold"}}]
+
+
 def test_topn_tanimoto_matches_single_node(cluster3, tmp_path):
     """Tanimoto must be computed on GLOBAL counts: a row split across
     nodes would be kept/dropped differently under per-node filtering

@@ -289,6 +289,30 @@ def test_group_by_previous_pagination(holder, use_mesh):
         e.execute("i", "GroupBy(Rows(a), Rows(b), previous=[1])")
 
 
+# -- Options (executor.go:340-403 executeOptionsCall) -----------------------
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_options_attrs_and_exclusions(holder, use_mesh):
+    f = setup_set_field(holder, [(1, 10), (1, 20), (2, 10)])
+    f.row_attrs.set_attrs(1, {"name": "alpha"})
+    idx = holder.index("i")
+    idx.column_attrs.set_attrs(10, {"city": "x"})
+    e = Executor(holder, use_mesh=use_mesh)
+    # plain Row carries its row attrs
+    row = e.execute("i", "Row(f=1)")[0]
+    assert row.attrs == {"name": "alpha"}
+    # columnAttrs attaches sets for columns that have attrs
+    row = e.execute("i", "Options(Row(f=1), columnAttrs=true)")[0]
+    assert row.column_attrs == [{"id": 10, "attrs": {"city": "x"}}]
+    # excludeRowAttrs strips row attrs; excludeColumns strips columns
+    row = e.execute("i", "Options(Row(f=1), excludeRowAttrs=true)")[0]
+    assert row.attrs == {}
+    row = e.execute("i", "Options(Row(f=1), excludeColumns=true)")[0]
+    assert row.columns().size == 0
+    with pytest.raises(Exception, match="bool"):
+        e.execute("i", "Options(Row(f=1), columnAttrs=3)")
+
+
 # -- writes -----------------------------------------------------------------
 
 def test_set_clear(ex, holder):

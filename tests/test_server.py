@@ -186,6 +186,23 @@ def test_metrics_and_debug_vars(srv):
     assert snap["stackCache"]["executables"] >= 1
 
 
+def test_options_column_attrs_over_http(srv):
+    call(srv, "POST", "/index/oi", {})
+    call(srv, "POST", "/index/oi/field/f", {})
+    call(srv, "POST", "/index/oi/query",
+         b'Set(7, f=1) SetColumnAttrs(7, city="pdx") '
+         b'SetRowAttrs(f, 1, kind="x")')
+    out = call(srv, "POST", "/index/oi/query",
+               b"Options(Row(f=1), columnAttrs=true)")
+    assert out["columnAttrs"] == [{"id": 7, "attrs": {"city": "pdx"}}]
+    assert out["results"][0]["attrs"] == {"kind": "x"}
+    out = call(srv, "POST", "/index/oi/query",
+               b"Options(Row(f=1), excludeRowAttrs=true, "
+               b"excludeColumns=true)")
+    assert out["results"][0]["columns"] == []
+    assert "attrs" not in out["results"][0]
+
+
 def test_pprof_and_runtime_stats(srv):
     threads = call(srv, "GET", "/debug/pprof/threads", raw=True).decode()
     assert "thread " in threads and "handler.py" in threads
