@@ -122,18 +122,45 @@ def result_from_wire(d: dict):
 # -- internal RPC client ----------------------------------------------------
 
 class InternalClient:
-    """Node-to-node HTTP RPC (reference http/client.go:69 InternalClient)."""
+    """Node-to-node HTTP(S) RPC (reference http/client.go:69
+    InternalClient).  Hosts may carry an ``https://`` prefix; mutual-TLS
+    client credentials come from ``configure_tls``."""
 
     def __init__(self, timeout: float = 30.0):
         self.timeout = timeout
+        self._ssl_ctx = None
+
+    def configure_tls(self, cert: str, key: str, ca: str | None,
+                      skip_verify: bool = False):
+        """Client credentials for an https cluster (server/server.go
+        GetTLSConfig; tls-skip-verify for self-signed deployments)."""
+        import ssl
+        ctx = ssl.create_default_context(
+            cafile=ca if ca else None)
+        ctx.load_cert_chain(cert, key)
+        if skip_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        self._ssl_ctx = ctx
 
     def _request(self, host: str, method: str, path: str,
                  body: bytes | None = None,
                  ctype: str = "application/json",
                  timeout: float | None = None) -> tuple[int, bytes]:
+        https = host.startswith("https://")
+        host = host.removeprefix("https://").removeprefix("http://")
         h, _, p = host.rpartition(":")
-        conn = http.client.HTTPConnection(h or "localhost", int(p),
-                                          timeout=timeout or self.timeout)
+        if https:
+            import ssl
+            # no configured client context -> default VERIFIED context
+            # (never silently skip verification; skip-verify is an
+            # explicit configure_tls option)
+            conn = http.client.HTTPSConnection(
+                h or "localhost", int(p), timeout=timeout or self.timeout,
+                context=self._ssl_ctx or ssl.create_default_context())
+        else:
+            conn = http.client.HTTPConnection(
+                h or "localhost", int(p), timeout=timeout or self.timeout)
         try:
             headers = {"Content-Type": ctype,
                        "Content-Length": str(len(body or b""))}
@@ -605,24 +632,59 @@ class Cluster:
             counts, row_tot, src, c.args.get("ids"), n, tan_thresh,
             attr_name, attr_values, field)
 
+    def _execute_topn_two_phase(self, index: str, c: Call,
+                                shards: list[int]):
+        """TopN(n=k) across nodes in two bounded phases
+        (executor.go:879-899): phase 1 fans out a per-node candidate top
+        list — each node ships O(k) pairs, not every nonzero row — and
+        phase 2 re-fetches exact global counts for the union of candidate
+        ids.  APPROXIMATE like the reference's cache-based phase 1: a row
+        can rank below every node's candidate cutoff yet sum into the
+        global top k; the 4x slack makes that require a pathologically
+        skewed distribution, and the counts reported for returned rows are
+        always exact (phase 2)."""
+        n, _ = c.uint_arg("n")
+        phase1 = c.clone()
+        phase1.args["n"] = max(4 * n, n + 16)
+        results = []
+        for r in self._fan_out_read(index, phase1, shards):
+            results.extend(r)
+        candidates = sorted({p.id for p in results})
+        if not candidates:
+            return []
+        phase2 = c.clone()
+        del phase2.args["n"]
+        phase2.args["ids"] = candidates
+        merged = merge_pairs(self._fan_out_read(index, phase2, shards))
+        return sort_pairs([p for p in merged if p.count > 0], n or None)
+
     def _execute_read(self, index: str, c: Call, shards: list[int]):
         send = c
         if c.name == "TopN" and \
                 any(k in c.args for k in TOPN_EXTRAS):
             return self._execute_topn_extras(index, c, shards)
         if c.name == "TopN" and "n" in c.args:
-            # A node's local top-n would truncate rows whose global count
-            # only wins across nodes; the reference re-fetches exact counts
-            # in a second phase (executor.go:879-899).  Per-node counts
-            # here are exact already, so fan out WITHOUT the limit and
-            # apply n at reduce time.
+            if c.args.get("n") and "ids" not in c.args \
+                    and len(self.nodes) > 1:
+                # bounded two-phase protocol; n=0 (unlimited), explicit
+                # ids, and single-node clusters take the exact path below
+                return self._execute_topn_two_phase(index, c, shards)
+            # exact path: strip the limit so no node truncates rows whose
+            # global count only wins across nodes; n applies at reduce
             send = c.clone()
             del send.args["n"]
+        return self._reduce(index, c,
+                            self._fan_out_read(index, send, shards))
+
+    def _fan_out_read(self, index: str, send: Call,
+                      shards: list[int]) -> list[Any]:
+        """Fan a pinned read call out to shard owners with replica retry;
+        returns the per-group raw results (executor.go:2455 mapReduce)."""
         results: list[Any] = []
         exclude: set[str] = set()
         pending = list(shards)
         if not pending:
-            return self._reduce(index, c, [self._local_exec(index, send, [])])
+            return [self._local_exec(index, send, [])]
         for _attempt in range(len(self.nodes) + 1):
             if not pending and results:
                 break
@@ -655,7 +717,7 @@ class Cluster:
         if pending:
             raise ClusterError(
                 f"no replicas available for shards {pending} of {index!r}")
-        return self._reduce(index, c, results)
+        return results
 
     # -- writes ------------------------------------------------------------
 

@@ -364,7 +364,39 @@ class _HandlerClass(BaseHTTPRequestHandler):
 
 
 def make_http_server(api: API, host: str = "localhost", port: int = 10101,
-                     server=None) -> ThreadingHTTPServer:
+                     server=None, tls=None) -> ThreadingHTTPServer:
+    """``tls``: optional (certificate, key, ca_certificate|None) paths —
+    serves HTTPS, requiring client certificates (mutual TLS) when a CA is
+    given (reference server/tlsconfig.go, server/server.go GetTLSConfig)."""
     router = build_router(api, server)
     cls = type("Handler", (_HandlerClass,), {"router": router})
-    return ThreadingHTTPServer((host, port), cls)
+    if tls is None:
+        return ThreadingHTTPServer((host, port), cls)
+    import ssl
+    cert, key, ca = tls
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+    if ca:
+        ctx.load_verify_locations(ca)
+        ctx.verify_mode = ssl.CERT_REQUIRED  # mutual TLS
+
+    class _TLSServer(ThreadingHTTPServer):
+        """Per-connection TLS: the handshake runs in the HANDLER thread
+        (finish_request), never the accept loop — a stalled or plain-TCP
+        client must not block every other connection."""
+
+        def finish_request(self, request, client_address):
+            request.settimeout(30)  # bound the handshake
+            request = ctx.wrap_socket(request, server_side=True)
+            request.settimeout(None)
+            super().finish_request(request, client_address)
+
+        def handle_error(self, request, client_address):
+            # handshake failures (port scans, cert-less clients) are
+            # expected noise, not tracebacks
+            import sys
+            exc = sys.exc_info()[1]
+            if not isinstance(exc, (ssl.SSLError, OSError)):
+                super().handle_error(request, client_address)
+
+    return _TLSServer((host, port), cls)

@@ -42,6 +42,13 @@ class Config:
     metric_poll_interval: float = 60.0
     metric_service: str = "expvar"  # expvar | statsd | none
     metric_host: str = "localhost:8125"
+    # TLS (reference server/tlsconfig.go): serve HTTPS when certificate +
+    # key are set; a CA certificate additionally enforces MUTUAL TLS.
+    # Cluster peers must then be listed as https://host:port.
+    tls_certificate: str = ""
+    tls_key: str = ""
+    tls_ca_certificate: str = ""
+    tls_skip_verify: bool = False  # client side: don't verify peer certs
     verbose: bool = False
 
     @classmethod
@@ -68,6 +75,11 @@ class Config:
             "PILOSA_TPU_DEVICE_BUDGET_MB": ("device_budget_mb", int),
             "PILOSA_TPU_METRIC_SERVICE": ("metric_service", str),
             "PILOSA_TPU_METRIC_HOST": ("metric_host", str),
+            "PILOSA_TPU_TLS_CERTIFICATE": ("tls_certificate", str),
+            "PILOSA_TPU_TLS_KEY": ("tls_key", str),
+            "PILOSA_TPU_TLS_CA_CERTIFICATE": ("tls_ca_certificate", str),
+            "PILOSA_TPU_TLS_SKIP_VERIFY": (
+                "tls_skip_verify", lambda s: s == "true"),
         }
         for env, (attr, conv) in env_map.items():
             if env in os.environ:
@@ -102,6 +114,13 @@ class Config:
             cfg.replica_n = cluster["replicas"]
         if "anti-entropy" in doc and "interval" in doc["anti-entropy"]:
             cfg.anti_entropy_interval = float(doc["anti-entropy"]["interval"])
+        tls = doc.get("tls", {})
+        for key, attr in (("certificate", "tls_certificate"),
+                          ("key", "tls_key"),
+                          ("ca-certificate", "tls_ca_certificate"),
+                          ("skip-verify", "tls_skip_verify")):
+            if key in tls:
+                setattr(cfg, attr, tls[key])
         cls._apply_env(cfg)
         cls._apply_overrides(cfg, overrides)
         return cfg
@@ -143,12 +162,23 @@ class Server:
         self.api = API(self.holder, cluster=self.cluster, stats=self.stats,
                        use_mesh=self.config.use_mesh)
         host, port = self._parse_bind(self.config.bind)
-        self.httpd = make_http_server(self.api, host, port, server=self)
+        tls = None
+        if self.config.tls_certificate and self.config.tls_key:
+            tls = (self.config.tls_certificate, self.config.tls_key,
+                   self.config.tls_ca_certificate or None)
+            if self.cluster is not None:
+                self.cluster.client.configure_tls(
+                    self.config.tls_certificate, self.config.tls_key,
+                    self.config.tls_ca_certificate or None,
+                    self.config.tls_skip_verify)
+        self.httpd = make_http_server(self.api, host, port, server=self,
+                                      tls=tls)
         self._threads: list[threading.Thread] = []
         self._closing = threading.Event()
 
     @staticmethod
     def _parse_bind(bind: str) -> tuple[str, int]:
+        bind = bind.removeprefix("https://").removeprefix("http://")
         host, _, port = bind.rpartition(":")
         return host or "localhost", int(port)
 
