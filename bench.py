@@ -126,7 +126,7 @@ def _run_batches(executor, index, batches, n_threads, shards_of=None):
 
 
 def bench_config1(executor, meta, rng):
-    B, n_batches, T = 512, 48, 16
+    B, n_batches, T = 1024, 64, 32
 
     def batch():
         rows = rng.integers(0, meta["star_rows"], size=B)
@@ -140,7 +140,7 @@ def bench_config1(executor, meta, rng):
 
 
 def bench_config2(executor, meta, rng):
-    B, n_batches, T = 512, 48, 16
+    B, n_batches, T = 1024, 64, 32
     n_rows = meta["star_rows"]
 
     def batch():

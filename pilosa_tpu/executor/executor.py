@@ -63,6 +63,31 @@ def topn_extras(c: Call):
     return tan_thresh, attr_name, attr_values
 
 
+class _PendingGroup:
+    """One pending filling MANY result slots: a batched call group's B
+    results resolve with ONE vectorized ``fin`` instead of B per-call
+    closures (measurably cheaper at B≥1024 on the serving hot path).
+    Place the same instance at every slot in ``call_idxs``; ``fin(hp)``
+    returns an indexable of per-slot values."""
+
+    __slots__ = ("parts", "pos", "fin", "_vec")
+
+    def __init__(self, parts, call_idxs, fin):
+        self.parts = list(parts)
+        self.pos = {i: b for b, i in enumerate(call_idxs)}
+        self.fin = fin
+        self._vec = None
+
+    @classmethod
+    def counts(cls, parts, call_idxs):
+        """Group of B Counts: per-group [B] vectors summed in one numpy
+        op (shared by the grouped executor and the prepared cache)."""
+        nB = len(call_idxs)
+        return cls(parts, call_idxs,
+                   lambda hp: ([int(x) for x in np.sum(hp, axis=0)]
+                               if hp else [0] * nB))
+
+
 class _Pending:
     """A dispatched-but-unresolved call result.
 
@@ -87,11 +112,11 @@ def _resolve_pendings(results):
     ``jax.device_get`` on the whole list rides one transfer round trip
     (measured: N serial fetches cost N tunnel RTTs, one device_get of N
     arrays costs one)."""
-    pend = [r for r in results if isinstance(r, _Pending)]
     unique: dict[int, Any] = {}
-    for r in pend:
-        for p in r.parts:
-            unique.setdefault(id(p), p)
+    for r in results:
+        if isinstance(r, (_Pending, _PendingGroup)):
+            for p in r.parts:
+                unique.setdefault(id(p), p)
     host: dict[int, np.ndarray] = {}
     if unique:
         import jax
@@ -99,9 +124,13 @@ def _resolve_pendings(results):
         for pid, arr in zip(unique.keys(), fetched):
             host[pid] = np.asarray(arr)
     out = []
-    for r in results:
+    for i, r in enumerate(results):
         if isinstance(r, _Pending):
             out.append(r.fin([host[id(p)] for p in r.parts]))
+        elif isinstance(r, _PendingGroup):
+            if r._vec is None:
+                r._vec = r.fin([host[id(p)] for p in r.parts])
+            out.append(r._vec[r.pos[i]])
         else:
             out.append(r)
     return out
@@ -247,10 +276,9 @@ class Executor:
             if kind == "count":
                 parts = self.mesh_exec.count_batch_async(
                     ds[0]["slotted"], params_mat, self.holder, index, shards)
-                for b, i in enumerate(idxs):
-                    results[i] = _Pending(
-                        parts,
-                        lambda hp, b=b: sum(int(p[b]) for p in hp))
+                grp = _PendingGroup.counts(parts, idxs)
+                for i in idxs:
+                    results[i] = grp
             elif kind == "sum":
                 parts = self.mesh_exec.bsi_sum_batch_async(
                     ds[0]["field"], ds[0]["view"], ds[0]["slotted"],

@@ -54,29 +54,46 @@ from .results import ValCount, rank_counts
 # Integer literals only: quoted strings and bare timestamps pass through
 # unchanged (they stay part of the template).  The lookaround classes keep
 # digits inside identifiers/barewords/floats (``field1``, ``1a2b``, ``1.5``,
-# ``2017-01-01``) out of the value list.
+# ``2017-01-01``) out of the value list.  The whole pattern is one capture
+# group (with the int literal as an inner group) so ``split`` can rebuild
+# the template at C speed — a Python callback per match costs ~30 µs/query
+# on the serving hot path.
 _FP = re.compile(
-    r"'(?:[^'\\]|\\.)*'"
+    r"('(?:[^'\\]|\\.)*'"
     r'|"(?:[^"\\]|\\.)*"'
     r"|\d{4}-[01]\d-[0-3]\dT\d\d:\d\d"
-    r"|(?<![\w.:-])(-?\d+)(?![\w.:-])")
+    r"|(?<![\w.:-])(-?\d+)(?![\w.:-]))")
 
 
 def fingerprint(query: str):
-    """(template, values, spans): the query text with int literals replaced
-    by '?', the literal values in source order, and token-start -> literal
-    index for the parser's mkint hook."""
-    values: list[int] = []
+    """(template, values): the query text with int literals replaced by
+    '?' and the literal values in source order.  Vectorized: one regex
+    split, list slicing, one join — no per-match Python callback."""
+    parts = _FP.split(query)
+    if len(parts) == 1:
+        return query, []
+    texts = parts[0::3]
+    fulls = parts[1::3]
+    ints = parts[2::3]
+    values = [int(x) for x in ints if x is not None]
+    out = []
+    for t, fl, iv in zip(texts, fulls, ints):
+        out.append(t)
+        out.append("?" if iv is not None else fl)
+    out.append(texts[-1])
+    return "".join(out), values
+
+
+def fingerprint_spans(query: str) -> dict[int, int]:
+    """token-start -> literal index for the parser's mkint hook (build
+    path only — hits never need spans)."""
     spans: dict[int, int] = {}
-
-    def sub(m):
-        if m.group(1) is None:
-            return m.group()
-        spans[m.start(1)] = len(values)
-        values.append(int(m.group(1)))
-        return "?"
-
-    return _FP.sub(sub, query), values, spans
+    i = 0
+    for m in _FP.finditer(query):
+        if m.group(2) is not None:
+            spans[m.start(2)] = i
+            i += 1
+    return spans
 
 
 _BATCHABLE = {"Count", "Sum", "TopN"}
@@ -158,7 +175,7 @@ class PreparedEntry:
     def run(self, ex, index: str, values: np.ndarray, shards):
         """Dispatch all groups, then resolve with one device fetch.
         Returns the results list, in call order."""
-        from .executor import _Pending, _resolve_pendings
+        from .executor import _Pending, _PendingGroup, _resolve_pendings
 
         holder = ex.holder
         if shards is None:
@@ -171,9 +188,9 @@ class PreparedEntry:
             if g.kind == "count":
                 parts = mesh.count_batch_async(g.slotted, params, holder,
                                                index, shards)
-                for b, i in enumerate(g.call_idxs):
-                    results[i] = _Pending(
-                        parts, lambda hp, b=b: sum(int(p[b]) for p in hp))
+                grp = _PendingGroup.counts(parts, g.call_idxs)
+                for i in g.call_idxs:
+                    results[i] = grp
             elif g.kind == "sum":
                 parts = mesh.bsi_sum_batch_async(
                     g.extra["field"], g.extra["view"], g.slotted, params,
@@ -231,7 +248,7 @@ class PreparedCache:
         (True, results) on a hit; (False, parsed_query_or_None) on a miss
         — the parsed AST (literal-tagged, tags invisible to the classic
         path) is handed back so the caller never parses twice."""
-        template, values, spans = fingerprint(query)
+        template, values = fingerprint(query)
         key = (index, template)
         with self._lock:
             entry = self._entries.get(key)
@@ -257,6 +274,7 @@ class PreparedCache:
 
         # build: tagged parse + prepare; on ineligibility remember that
         self.misses += 1
+        spans = fingerprint_spans(query)
         q = parse(query, mkint=lambda v, s: (
             LitInt(v, spans[s], v - values[spans[s]]) if s in spans else v))
         entry = self._prepare(index, q, values)

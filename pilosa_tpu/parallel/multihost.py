@@ -1,0 +1,72 @@
+"""Multi-host execution: one engine spanning hosts over DCN.
+
+Two composition modes cover the reference's multi-node story (SURVEY §5.8
+— its HTTP+protobuf data plane and gossip membership):
+
+1. **Cluster of single-host nodes** (parallel/cluster.py): each process
+   owns a shard subset on its local devices; node-to-node traffic is the
+   HTTP control plane.  This replaces the reference's NCCL-free
+   scatter/gather star and is the default deployment.
+
+2. **One multi-host mesh node**: all hosts join a single jax distributed
+   runtime; the MeshExecutor's mesh spans every host's devices, and
+   cross-shard reductions (psum) ride ICI within a slice and DCN across
+   slices — XLA inserts and schedules the collectives.  A pilosa-tpu
+   Server on the coordinator process then serves queries whose shard axis
+   covers the global device set.  Use when one index's working set
+   exceeds a host's HBM but the query rate does not require independent
+   replicas.
+
+This module wires mode 2: ``init_distributed`` brings up the jax
+distributed runtime (the DCN rendezvous the reference's memberlist gossip
+played for membership), and ``global_mesh`` builds the shard-axis mesh
+over all processes' devices for ``Executor(mesh=...)``.
+
+The driver-facing proof for this path is ``__graft_entry__.
+dryrun_multichip``, which compiles and runs the full distributed query set
+over an N-device mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def init_distributed(coordinator: str, num_processes: int,
+                     process_id: int):
+    """Join the jax distributed runtime (jax.distributed.initialize).
+
+    ``coordinator``: "host:port" of process 0.  Must run before any
+    device use in the process.  After it returns, ``jax.devices()`` spans
+    every host and collectives cross DCN transparently."""
+    import jax
+
+    if num_processes < 1:
+        raise ValueError("num_processes must be >= 1")
+    if not 0 <= process_id < num_processes:
+        raise ValueError(
+            f"process_id {process_id} out of range [0, {num_processes})")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def global_mesh():
+    """A 1-d shard-axis Mesh over ALL processes' devices (the mesh the
+    reference's cluster-wide shard ring corresponds to).  Pass to
+    ``Executor(mesh=...)`` / ``MeshExecutor(mesh)``."""
+    from .mesh_exec import default_mesh
+
+    # jax.devices() is already global in a distributed runtime
+    return default_mesh()
+
+
+def process_shard_slice(n_shards: int) -> tuple[int, int]:
+    """The contiguous shard range this process would own under an even
+    split — a helper for feeding per-host import pipelines."""
+    import jax
+
+    n = jax.process_count()
+    i = jax.process_index()
+    per = (n_shards + n - 1) // n
+    return min(i * per, n_shards), min((i + 1) * per, n_shards)

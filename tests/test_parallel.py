@@ -179,6 +179,26 @@ def test_server_config_sets_device_budget(tmp_path):
         DEFAULT_BUDGET.limit_bytes = old
 
 
+def test_global_mesh_executor(loaded):
+    """multihost.global_mesh: a mesh over every process device drives the
+    same executor path (single process here; multi-process differs only
+    in where jax.devices() live)."""
+    from pilosa_tpu.parallel import multihost
+    h, _, _ = loaded
+    mesh = multihost.global_mesh()
+    assert mesh.devices.size == 8
+    me = Executor(h, mesh=mesh)
+    plain = Executor(h)
+    q = "Count(Intersect(Row(f=1), Row(f=2)))"
+    assert me.execute("i", q) == plain.execute("i", q)
+    lo, hi = multihost.process_shard_slice(10)
+    assert (lo, hi) == (0, 10)
+    with pytest.raises(ValueError):
+        multihost.init_distributed("localhost:1", 0, 0)
+    with pytest.raises(ValueError):
+        multihost.init_distributed("localhost:1", 2, 5)
+
+
 def test_plan_cache_keyed_by_shape(loaded):
     """Distinct row ids and BSI predicate values must share ONE compiled
     executable — literals are runtime params, not baked constants

@@ -49,17 +49,18 @@ def classic(holder):
 
 
 def test_fingerprint_literals():
-    t, vals, spans = fingerprint(
-        "Count(Row(f=14)) Row(v > -3) TopN(f, n=50, ids=[1,2])")
+    from pilosa_tpu.executor.prepared import fingerprint_spans
+    q = "Count(Row(f=14)) Row(v > -3) TopN(f, n=50, ids=[1,2])"
+    t, vals = fingerprint(q)
     assert t == "Count(Row(f=?)) Row(v > ?) TopN(f, n=?, ids=[?,?])"
     assert vals == [14, -3, 50, 1, 2]
-    assert len(spans) == 5
+    assert len(fingerprint_spans(q)) == 5
 
 
 def test_fingerprint_preserves_strings_timestamps_and_words():
     q = ("Row(f=7, from='2017-01-01T00:00', to=2018-06-02T11:30) "
          "Set('k9', f=3) Count(Row(g1=1a2b)) Row(x=1.5)")
-    t, vals, _ = fingerprint(q)
+    t, vals = fingerprint(q)
     assert "'2017-01-01T00:00'" in t
     assert "2018-06-02T11:30" in t
     assert "'k9'" in t
