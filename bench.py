@@ -256,6 +256,101 @@ def bench_config5(rng):
         ex5.close()
 
 
+def bench_config5_distributed(rng):
+    """BASELINE config 5's cluster half: 4 real server nodes in-process
+    (sharing the one local accelerator), Intersect+TopN fanned out and
+    reduced over real HTTP (executor.go:2414-2552 scatter/gather)."""
+    import http.client
+    import socket
+    import tempfile
+
+    from pilosa_tpu.core import SHARD_WIDTH
+    from pilosa_tpu.server import Config, Server
+
+    socks = []
+    for _ in range(4):
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = []
+
+    def post(port, path, body: bytes):
+        conn = http.client.HTTPConnection("localhost", port, timeout=300)
+        conn.request("POST", path, body=body)
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        assert resp.status == 200, data
+        return data
+
+    try:
+        for i, p in enumerate(ports):
+            srv = Server(Config(
+                data_dir=tempfile.mkdtemp(prefix=f"ptpu_b5d_{i}_"),
+                bind=hosts[i], node_id=f"node{i}", cluster_hosts=hosts,
+                replica_n=1, anti_entropy_interval=0))
+            servers.append(srv)  # before open: finally closes partials
+            srv.open()
+        n_shards = 256  # ~268M columns over 4 nodes
+        p0 = ports[0]
+        post(p0, "/index/dist", b"{}")
+        post(p0, "/index/dist/field/seg", b"{}")
+        post(p0, "/index/dist/field/metric", b"{}")
+        n_bits = 1_000_000
+        cols = rng.integers(0, n_shards * SHARD_WIDTH, size=n_bits)
+        # each column joins TWO seg rows so Intersect(seg=a, seg=b) is
+        # non-trivial — disjoint memberships would benchmark merging
+        # empty result sets
+        segs = rng.integers(0, 4, size=n_bits)
+        segs2 = (segs + 1 + rng.integers(0, 3, size=n_bits)) % 4
+        mets = rng.integers(0, 8, size=n_bits)
+        chunk = 200_000
+        for lo in range(0, n_bits, chunk):
+            sel = slice(lo, lo + chunk)
+            post(p0, "/index/dist/field/seg/import", json.dumps(
+                {"rowIDs": np.concatenate(
+                    [segs[sel], segs2[sel]]).tolist(),
+                 "columnIDs": np.concatenate(
+                    [cols[sel], cols[sel]]).tolist()}).encode())
+            post(p0, "/index/dist/field/metric/import", json.dumps(
+                {"rowIDs": mets[sel].tolist(),
+                 "columnIDs": cols[sel].tolist()}).encode())
+
+        B, n_batches, T = 16, 16, 8
+
+        def batch():
+            pairs = [(int(a), int((a + 1) % 4))
+                     for a in rng.integers(0, 4, size=B)]
+            return " ".join(
+                f"TopN(metric, Intersect(Row(seg={a}), Row(seg={b})), n=5)"
+                for a, b in pairs)
+
+        post(p0, "/index/dist/query", batch().encode())  # warm/compile
+        batches = [(ports[i % 4], batch().encode())
+                   for i in range(n_batches)]
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(T) as pool:
+            list(pool.map(
+                lambda pb: post(pb[0], "/index/dist/query", pb[1]),
+                batches))
+        dt = time.perf_counter() - t0
+        return {
+            "qps": round(B * n_batches / dt, 1),
+            "nodes": 4,
+            "columns": n_shards << 20,
+        }
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
 # -- numpy oracle baselines (single-thread reference-algorithm stand-in) ----
 
 def _np_frag(holder, index, field, view=None):
@@ -383,6 +478,10 @@ def main():
     assert got == int(np.bitwise_count(frag[14]).sum()), "config1 mismatch"
 
     cfg5 = bench_config5(rng)
+    try:
+        cfg5d = bench_config5_distributed(rng)
+    except Exception:
+        cfg5d = None
 
     # HTTP variant (engine behind the real server)
     http_qps = None
@@ -422,6 +521,8 @@ def main():
             "groupby_s": round(gb_s, 3)},
         "5_topn_1B_cols_budgeted": cfg5,
     }
+    if cfg5d:
+        configs["5d_intersect_topn_4node_cluster"] = cfg5d
     if http_qps:
         configs["2_http_path"] = {"qps": round(http_qps, 1)}
 

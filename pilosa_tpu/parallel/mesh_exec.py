@@ -266,10 +266,21 @@ class MeshExecutor:
             self._finalizer()
             self._cache.clear()
 
+    def _bucket(self, n: int) -> int:
+        """Stacked shard counts round UP to n_devices * 2^k: executables
+        are keyed by shape, and a one-shard difference between two shard
+        sets (resize, Options(shards=...), working-set rotation) must not
+        pay a multi-second XLA recompile.  Padding shards are zero blocks
+        — they contribute nothing to counts/reductions."""
+        b = self.n_devices
+        while b < n:
+            b *= 2
+        return b
+
     def _pad_and_place(self, arrays_list, shape, n: int):
-        """Stack n member arrays, pad to a multiple of n_devices, and place
-        sharded over the mesh axis."""
-        pad = (-n) % self.n_devices
+        """Stack n member arrays, pad the shard axis to its bucket, and
+        place sharded over the mesh axis."""
+        pad = self._bucket(n) - n
         mats = list(arrays_list)
         if pad:
             zero = jax.device_put(
@@ -284,8 +295,7 @@ class MeshExecutor:
         block and place it mesh-sharded in a single transfer (bypassing
         per-fragment mirrors entirely)."""
         n = len(frs)
-        pad = (-n) % self.n_devices
-        block = np.zeros((n + pad,) + shape, dtype=np.uint32)
+        block = np.zeros((self._bucket(n),) + shape, dtype=np.uint32)
         for i, fr in enumerate(frs):
             dense = fr.to_dense()
             r = min(dense.shape[0], shape[0])  # cap may race a grow
