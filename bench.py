@@ -34,6 +34,7 @@ Methodology notes (load-bearing, see .claude/skills/verify/SKILL.md):
 """
 
 import json
+import sys
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -341,7 +342,7 @@ def bench_config5_distributed(rng):
         return {
             "qps": round(B * n_batches / dt, 1),
             "nodes": 4,
-            "columns": n_shards << 20,
+            "columns": n_shards * SHARD_WIDTH,
         }
     finally:
         for s in servers:
@@ -480,7 +481,10 @@ def main():
     cfg5 = bench_config5(rng)
     try:
         cfg5d = bench_config5_distributed(rng)
-    except Exception:
+    except Exception as e:
+        import traceback
+        print(f"config 5d failed: {e!r}", file=sys.stderr)
+        traceback.print_exc()
         cfg5d = None
 
     # HTTP variant (engine behind the real server)

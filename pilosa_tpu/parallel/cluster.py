@@ -286,11 +286,41 @@ class RemoteTranslateStore:
         self.field = field
         self._k2i: dict[str, int] = {}
         self._i2k: dict[int, str] = {}
+        self._sync_after = 0  # contiguous replication watermark
         self._lock = threading.RLock()
 
     def _path(self) -> str:
         p = f"/internal/translate/{self.index}"
         return p + (f"/{self.field}" if self.field else "")
+
+    # entries per catch-up page (bounds coordinator lock hold + response
+    # size; the loop below drains all pages)
+    SYNC_PAGE = 50_000
+
+    def sync_entries(self) -> int:
+        """Streaming replication catch-up (holder.go:812
+        holderTranslateStoreReplicator): page entries after our CONTIGUOUS
+        replication watermark from the coordinator, so reads on this
+        replica stop paying a coordinator round trip for keys written
+        since the last pass.  The watermark is separate from the lookup
+        cache — a read-through hit on a high id must not make replication
+        skip everything below it.  Driven from the anti-entropy loop."""
+        total = 0
+        while True:
+            out = self.client._json(
+                self.host, "POST", self._path(),
+                {"after": self._sync_after, "limit": self.SYNC_PAGE})
+            entries = out.get("entries", [])
+            if entries:
+                with self._lock:
+                    for kid, key in entries:
+                        self._k2i[key] = kid
+                        self._i2k[kid] = key
+                self._sync_after = max(self._sync_after,
+                                       max(kid for kid, _ in entries))
+                total += len(entries)
+            if len(entries) < self.SYNC_PAGE:
+                return total
 
     def translate_key(self, key: str) -> int:
         with self._lock:
@@ -1030,6 +1060,25 @@ class Cluster:
                         self._sync_fragment(index_name, fname, vname, s,
                                             owners, unpack_roaring)
         self._sync_attrs()
+        self._sync_translate_entries()
+
+    def _sync_translate_entries(self):
+        """Replica key-table catch-up: pull new translate entries from the
+        coordinator for every keyed index/field (the streaming replication
+        of holder.go:812, batched onto the anti-entropy cadence)."""
+        for idx in list(self.holder.indexes.values()):
+            stores = []
+            if idx.keys:
+                stores.append(idx.translate_store())
+            for f in list(idx.fields.values()):
+                if f.options.keys:
+                    stores.append(f.translate_store())
+            for ts in stores:
+                if isinstance(ts, RemoteTranslateStore):
+                    try:
+                        ts.sync_entries()
+                    except Exception:
+                        pass  # next pass retries
 
     def _ready_peer_hosts(self, node_ids) -> list[tuple[str, str]]:
         return [(nid, self.by_id[nid].host) for nid in node_ids
@@ -1433,6 +1482,11 @@ class Cluster:
             body = req.json()
             if "keys" in body:
                 return {"ids": store.translate_keys(body["keys"])}
+            if "after" in body:
+                # replica catch-up stream (holder.go:812; translate.go:82)
+                return {"entries": store.entries_from(
+                    int(body["after"]), int(body.get("limit") or 0) or
+                    None)}
             return {"keys": store.translate_ids(body.get("ids", []))}
 
         router.add("POST", "/internal/translate/{index}", internal_translate)

@@ -100,9 +100,15 @@ class TranslateStore:
 
     # -- replication support (translate.go:82 TranslateEntryReader) --------
 
-    def entries_from(self, after_id: int) -> list[tuple[int, str]]:
-        """All (id, key) pairs with id > after_id, in order — the
-        replication/stream payload."""
+    def entries_from(self, after_id: int,
+                     limit: int | None = None) -> list[tuple[int, str]]:
+        """Up to ``limit`` (id, key) pairs with id > after_id, in order —
+        the replication/stream payload (paginated so one request neither
+        holds the store lock for a full-table copy nor exceeds a response
+        timeout)."""
         with self._lock:
+            hi = len(self._id_to_key) + 1
+            if limit is not None:
+                hi = min(hi, after_id + 1 + limit)
             return [(i, self._id_to_key[i])
-                    for i in range(after_id + 1, len(self._id_to_key) + 1)]
+                    for i in range(after_id + 1, hi)]

@@ -188,6 +188,38 @@ def test_keyed_cluster_roundtrip(tmp_path):
             s.close()
 
 
+def test_replica_translate_streaming_catchup(tmp_path):
+    """Anti-entropy pulls new key entries to replicas in one stream
+    (holder.go:812 holderTranslateStoreReplicator): after a sync, reads of
+    coordinator-written keys need no per-key round trips."""
+    from tests.test_cluster import make_cluster, _req, query
+    from pilosa_tpu.parallel.cluster import RemoteTranslateStore
+
+    servers = make_cluster(tmp_path, n=2, replica_n=2)
+    try:
+        p0 = servers[0].port
+        _req(p0, "POST", "/index/ki", {"options": {"keys": True}})
+        _req(p0, "POST", "/index/ki/field/f", {"options": {"keys": True}})
+        query(p0, "ki", 'Set("u1", f="admin") Set("u2", f="dev")')
+        # replica's stores are remote and empty-cached before the sync
+        idx1 = servers[1].holder.index("ki")
+        col_ts = idx1.translate_store()
+        row_ts = idx1.field("f").translate_store()
+        assert isinstance(col_ts, RemoteTranslateStore)
+        assert col_ts.find_key("u1") is None
+        servers[1].cluster.sync_holder()
+        assert col_ts.find_key("u1") is not None
+        assert col_ts.find_key("u2") is not None
+        assert row_ts.find_key("admin") is not None
+        # incremental: only NEW entries stream on the next pass
+        query(p0, "ki", 'Set("u3", f="admin")')
+        assert col_ts.sync_entries() == 1
+        assert col_ts.sync_entries() == 0
+    finally:
+        for s in servers:
+            s.close()
+
+
 def test_remote_translate_batches_requests(tmp_path):
     """N uncached keys/ids must translate in ONE coordinator POST, not N
     (r2 advisor's last open finding)."""
