@@ -1066,6 +1066,9 @@ class Cluster:
         """Replica key-table catch-up: pull new translate entries from the
         coordinator for every keyed index/field (the streaming replication
         of holder.go:812, batched onto the anti-entropy cadence)."""
+        if self.nodes[0].state != NODE_READY:
+            return  # coordinator down: don't stall the anti-entropy
+            #         thread on per-store timeouts (repair must continue)
         for idx in list(self.holder.indexes.values()):
             stores = []
             if idx.keys:
@@ -1483,10 +1486,14 @@ class Cluster:
             if "keys" in body:
                 return {"ids": store.translate_keys(body["keys"])}
             if "after" in body:
-                # replica catch-up stream (holder.go:812; translate.go:82)
+                # replica catch-up stream (holder.go:812; translate.go:82).
+                # A missing/0 limit clamps to one page — the server, not
+                # client politeness, enforces the pagination bound.
+                limit = int(body.get("limit") or 0)
+                page = RemoteTranslateStore.SYNC_PAGE
+                limit = min(limit, page) if limit > 0 else page
                 return {"entries": store.entries_from(
-                    int(body["after"]), int(body.get("limit") or 0) or
-                    None)}
+                    int(body["after"]), limit)}
             return {"keys": store.translate_ids(body.get("ids", []))}
 
         router.add("POST", "/internal/translate/{index}", internal_translate)

@@ -369,6 +369,52 @@ def _owned_frag_count(srv, index="ci"):
     return n
 
 
+def test_node_crash_recovery_lifecycle(tmp_path):
+    """The full §5.3 failure story: a node dies -> cluster DEGRADED but
+    reads keep serving from replicas -> the node restarts on its data dir
+    -> schema written while it was down catches up on the next probe ->
+    anti-entropy repairs the bits it missed -> NORMAL."""
+    servers = make_cluster(tmp_path, n=3, replica_n=2)
+    try:
+        setup_index(servers)
+        col = 5
+        query(servers[0].port, "ci", f"Set({col}, f=2)")
+        # kill node2 (keep its config + data dir for the restart)
+        dead_cfg = servers[2].config
+        servers[2].close()
+        servers[0].cluster.probe_peers()
+        assert servers[0].cluster.state == "DEGRADED"
+        # reads still answer from surviving replicas
+        [cnt] = query(servers[0].port, "ci", "Count(Row(f=2))")
+        assert cnt == 1
+        # DDL is disallowed while DEGRADED (api.go:99 validAPIMethods)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _req(servers[0].port, "POST", "/index/ci/field/g", {})
+        assert exc.value.code == 400
+
+        # restart the node on its old data dir
+        servers[2] = Server(dead_cfg)
+        servers[2].open()
+        servers[0].cluster.probe_peers()  # probes + schema catch-up
+        assert servers[0].cluster.state == "NORMAL"
+        # DDL works again and broadcasts everywhere incl. the restartee
+        _req(servers[0].port, "POST", "/index/ci/field/g", {})
+        schema = _req(servers[2].port, "GET", "/schema")["indexes"]
+        assert {f["name"] for f in schema[0]["fields"]} >= {"f", "g"}
+        # anti-entropy on the restarted node pulls anything it missed
+        servers[2].cluster.probe_peers()
+        servers[2].cluster.sync_holder()
+        for srv in servers:
+            [cnt] = query(srv.port, "ci", "Count(Row(f=2))")
+            assert cnt == 1, srv.cluster.node_id
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
 def test_resize_grow_and_shrink(tmp_path):
     """cluster.go:1196-1561 resize parity: 2->3 grow then 3->2 shrink with
     data intact, placement rebalanced, and unowned fragments GC'd
