@@ -703,13 +703,42 @@ class Executor:
         if not rows_calls:
             raise ExecutionError("GroupBy requires Rows() children")
 
-        fields = []
+        names = []
         for rc in rows_calls:
             fname, ok = rc.string_arg("_field")
             if not ok:
                 raise ExecutionError("Rows() requires a field")
-            ids = self._execute_rows(index, rc, shards).rows
-            fields.append((fname, ids))
+            names.append(fname)
+
+        fields = []
+        # Plain Rows() children on the mesh path take a row-id GRID
+        # instead of executing Rows first: every (field, row<=max_row)
+        # combo is counted and zero-count groups drop out, which is the
+        # same answer without the per-child blocking device round trips
+        # (the odometer seeds of executor.go:3058, folded into the combo
+        # dispatch).
+        if self.mesh_exec is not None and \
+                all(set(rc.args) == {"_field"} for rc in rows_calls):
+            caps = []
+            for fname in names:
+                f = self.holder.field(index, fname)
+                if f is None:
+                    raise ExecutionError(f"field not found: {fname}")
+                v = f.view(VIEW_STANDARD)
+                cap = 0 if v is None else max(
+                    (fr.max_row_id() + 1 for fr in v.fragments.values()
+                     if fr.host_bytes()), default=0)
+                caps.append(cap)
+            total = 1
+            for c_ in caps:
+                total *= c_
+            if 0 < total <= 4096:
+                fields = [(fname, list(range(c_)))
+                          for fname, c_ in zip(names, caps)]
+        if not fields:
+            for fname, rc in zip(names, rows_calls):
+                ids = self._execute_rows(index, rc, shards).rows
+                fields.append((fname, ids))
 
         # previous=[row per Rows child]: resume pagination strictly after
         # that group (executor.go:1403, :3058 groupByIterator seek)
