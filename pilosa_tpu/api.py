@@ -346,7 +346,13 @@ class API:
         return __version__
 
     def max_shards(self) -> dict[str, int]:
-        """(api.go MaxShards, /internal/shards/max)"""
+        """(api.go MaxShards, /internal/shards/max).  Cluster-wide: a
+        node answering for shards it doesn't own must still report them
+        (the export CLI walks 0..max and routes each shard to an owner)."""
+        if self.cluster is not None:
+            return {name: max(self.cluster._available_shards(
+                                  name, mark_down=False), default=0)
+                    for name in list(self.holder.indexes)}
         return {name: max(idx.available_shards(), default=0)
                 for name, idx in self.holder.indexes.items()}
 

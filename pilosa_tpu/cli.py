@@ -120,17 +120,35 @@ def cmd_import(args) -> int:
 
 
 def cmd_export(args) -> int:
-    """(ctl/export.go:35-112)"""
+    """(ctl/export.go:35-112).  Each shard is fetched from a node that
+    OWNS it (ctl/export.go fragment-nodes routing) — a single-host fetch
+    would silently miss shards placed on other cluster nodes."""
     base = f"http://{args.host}"
     maxes = _http("GET", f"{base}/internal/shards/max")["standard"]
     max_shard = maxes.get(args.index, 0)
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     for shard in range(max_shard + 1):
-        url = (f"{base}/export?index={args.index}&field={args.field}"
-               f"&shard={shard}")
-        req = urllib.request.Request(url)
-        with urllib.request.urlopen(req) as resp:
-            out.write(resp.read().decode())
+        nodes = _http("GET", f"{base}/internal/fragment/nodes"
+                             f"?index={args.index}&shard={shard}")
+        hosts = [n["uri"] for n in nodes if n.get("uri")] or [args.host]
+        last_err = None
+        for host in hosts:  # replica failover: any live owner serves
+            url = (f"http://{host}/export?index={args.index}"
+                   f"&field={args.field}&shard={shard}")
+            try:
+                with urllib.request.urlopen(
+                        urllib.request.Request(url)) as resp:
+                    out.write(resp.read().decode())
+                last_err = None
+                break
+            except OSError as e:
+                last_err = e
+        if last_err is not None:
+            print(f"export: shard {shard}: no reachable owner "
+                  f"({last_err})", file=sys.stderr)
+            if out is not sys.stdout:
+                out.close()
+            return 1
     if out is not sys.stdout:
         out.close()
     return 0

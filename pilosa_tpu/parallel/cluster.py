@@ -521,11 +521,15 @@ class Cluster:
 
     # -- shard discovery ---------------------------------------------------
 
-    def _available_shards(self, index: str) -> list[int]:
+    def _available_shards(self, index: str,
+                          mark_down: bool = True) -> list[int]:
         """Union of local + peer available shards.  The reference gossips
         per-field available-shard bitmaps (field.go:263); with static
         membership we ask peers directly and fold the answer into
-        remote-known shards so it converges without re-asking."""
+        remote-known shards so it converges without re-asking.
+        ``mark_down=False`` for read-only informational callers (e.g.
+        /internal/shards/max): a transient peer timeout there must not
+        flip the cluster DEGRADED."""
         idx = self.holder.index(index)
         shards = set(idx.available_shards()) if idx is not None else set()
         for n in self.peers():
@@ -534,7 +538,8 @@ class Cluster:
             try:
                 shards.update(self.client.available_shards(n.host, index))
             except Exception:
-                self._mark_down(n.id)
+                if mark_down:
+                    self._mark_down(n.id)
         return sorted(shards)
 
     # -- query fan-out (executor.go:2455 mapReduce) ------------------------

@@ -31,6 +31,37 @@ def test_import_export_roundtrip(srv, tmp_path, capsys):
         {"1,10", "1,20", "2,1048586"}
 
 
+def test_cluster_export_covers_remote_shards(tmp_path):
+    """Export through ONE node must fetch each shard from an owner —
+    shards placed on other nodes are not silently dropped
+    (ctl/export.go fragment-nodes routing)."""
+    from tests.test_cluster import make_cluster
+
+    servers = make_cluster(tmp_path, n=3, replica_n=1)
+    try:
+        from pilosa_tpu.core import SHARD_WIDTH
+        csv = tmp_path / "in.csv"
+        lines = [f"1,{s * SHARD_WIDTH + 7}" for s in range(8)]
+        csv.write_text("\n".join(lines) + "\n")
+        p0 = servers[0].port
+        rc = main(["import", "-host", f"localhost:{p0}",
+                   "-i", "x", "-f", "f", "--create", str(csv)])
+        assert rc == 0
+        # replica_n=1: some of the 8 shards live only on nodes 1/2
+        owned0 = {s for s in range(8)
+                  if "node0" in
+                  servers[0].cluster.placement.shard_nodes("x", s)}
+        assert owned0 != set(range(8))
+        out = tmp_path / "out.csv"
+        rc = main(["export", "-host", f"localhost:{p0}",
+                   "-i", "x", "-f", "f", "-o", str(out)])
+        assert rc == 0
+        assert set(out.read_text().strip().split("\n")) == set(lines)
+    finally:
+        for s in servers:
+            s.close()
+
+
 def test_import_int_field(srv, tmp_path):
     csv = tmp_path / "vals.csv"
     csv.write_text("1,100\n2,-5\n")
