@@ -42,6 +42,11 @@ class Config:
     metric_poll_interval: float = 60.0
     metric_service: str = "expvar"  # expvar | statsd | none
     metric_host: str = "localhost:8125"
+    # Diagnostics (reference diagnostics.go, default-off here): when an
+    # endpoint is set, POST an anonymized runtime/schema summary there on
+    # the given interval — for the OPERATOR's fleet monitoring.
+    diagnostics_endpoint: str = ""
+    diagnostics_interval: float = 3600.0
     # TLS (reference server/tlsconfig.go): serve HTTPS when certificate +
     # key are set; a CA certificate additionally enforces MUTUAL TLS.
     # Cluster peers must then be listed as https://host:port.
@@ -75,6 +80,10 @@ class Config:
             "PILOSA_TPU_DEVICE_BUDGET_MB": ("device_budget_mb", int),
             "PILOSA_TPU_METRIC_SERVICE": ("metric_service", str),
             "PILOSA_TPU_METRIC_HOST": ("metric_host", str),
+            "PILOSA_TPU_DIAGNOSTICS_ENDPOINT": ("diagnostics_endpoint",
+                                                str),
+            "PILOSA_TPU_DIAGNOSTICS_INTERVAL": ("diagnostics_interval",
+                                                float),
             "PILOSA_TPU_TLS_CERTIFICATE": ("tls_certificate", str),
             "PILOSA_TPU_TLS_KEY": ("tls_key", str),
             "PILOSA_TPU_TLS_CA_CERTIFICATE": ("tls_ca_certificate", str),
@@ -173,6 +182,10 @@ class Server:
                     self.config.tls_skip_verify)
         self.httpd = make_http_server(self.api, host, port, server=self,
                                       tls=tls)
+        from ..utils.diagnostics import DiagnosticsCollector
+        self.diagnostics = DiagnosticsCollector(
+            self, self.config.diagnostics_endpoint,
+            self.config.diagnostics_interval)
         self._threads: list[threading.Thread] = []
         self._closing = threading.Event()
 
@@ -209,6 +222,7 @@ class Server:
             t = threading.Thread(target=self._monitor_runtime, daemon=True)
             t.start()
             self._threads.append(t)
+        self.diagnostics.open()  # no-op unless an endpoint is configured
 
     def collect_runtime_stats(self):
         """Process-level gauges (server.go:813 monitorRuntime + gopsutil;
@@ -252,6 +266,7 @@ class Server:
 
     def close(self):
         self._closing.set()
+        self.diagnostics.close()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self.cluster is not None:

@@ -1,0 +1,81 @@
+"""Diagnostics reporting (reference diagnostics.go:42-263).
+
+The reference phones home hourly to a hard-coded vendor endpoint; this
+rebuild keeps the subsystem but inverts the default: reporting is OFF
+unless the operator configures ``diagnostics_endpoint``, and the payload
+goes to THEIR endpoint (fleet monitoring), not a vendor's.  The payload
+mirrors the reference's anonymized shape: version, platform, uptime,
+schema scale, and runtime gauges.
+"""
+
+from __future__ import annotations
+
+import json
+import platform
+import threading
+import time
+import urllib.request
+
+
+class DiagnosticsCollector:
+    def __init__(self, server, endpoint: str, interval: float = 3600.0):
+        self.server = server
+        self.endpoint = endpoint
+        self.interval = interval
+        self.start_time = time.time()
+        self._closing = threading.Event()
+        self._thread = None
+
+    def payload(self) -> dict:
+        """(diagnostics.go:80-151 CheckVersion/logic, minus identifiers)"""
+        from .. import __version__
+
+        holder = self.server.holder
+        with holder._lock:  # schema dicts mutate under this lock
+            indexes = list(holder.indexes.values())
+            fields = [f for i in indexes for f in list(i.fields.values())]
+        n_fields = len(fields)
+        n_frags = sum(len(v.fragments) for f in fields
+                      for v in list(f.views.values()))
+        out = {
+            "version": __version__,
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+            "uptimeSeconds": int(time.time() - self.start_time),
+            "numIndexes": len(holder.indexes),
+            "numFields": n_fields,
+            "numFragments": n_frags,
+        }
+        cluster = self.server.cluster
+        if cluster is not None:
+            out["numNodes"] = len(cluster.nodes)
+            out["replicaN"] = cluster.replica_n
+            out["clusterState"] = cluster.state
+        return out
+
+    def report_once(self) -> bool:
+        try:
+            body = json.dumps(self.payload()).encode()
+            req = urllib.request.Request(
+                self.endpoint, data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=10).read()
+            return True
+        except Exception as e:
+            # diagnostics must never take the server down, but a
+            # misconfigured endpoint must not fail invisibly either
+            self.server.logger.error(f"diagnostics report failed: {e}")
+            return False
+
+    def open(self):
+        if not self.endpoint or self.interval <= 0:
+            return  # interval 0 disables, like the other monitors
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._closing.wait(self.interval):
+            self.report_once()
+
+    def close(self):
+        self._closing.set()

@@ -215,6 +215,38 @@ def test_pprof_and_runtime_stats(srv):
     assert snap["gauges"]["runtime.threads"] >= 1
 
 
+def test_diagnostics_reporting(srv):
+    """diagnostics.go parity, inverted default: OFF unless the operator
+    configures an endpoint; the payload carries anonymized scale info."""
+    import http.server
+    import threading
+
+    assert srv.diagnostics._thread is None  # default: no reporting loop
+    call(srv, "POST", "/index/di", {})
+    call(srv, "POST", "/index/di/field/f", {})
+    got = {}
+
+    class Sink(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            got["body"] = json.loads(
+                self.rfile.read(int(self.headers["Content-Length"])))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    sink = http.server.HTTPServer(("localhost", 0), Sink)
+    threading.Thread(target=sink.handle_request, daemon=True).start()
+    srv.diagnostics.endpoint = \
+        f"http://localhost:{sink.server_address[1]}/d"
+    assert srv.diagnostics.report_once()
+    assert got["body"]["numIndexes"] >= 1
+    assert got["body"]["version"]
+    assert "uptimeSeconds" in got["body"]
+    sink.server_close()
+
+
 def test_statsd_client_emits_datagrams():
     import socket
     from pilosa_tpu.utils.stats import StatsdClient, make_stats_client
