@@ -31,9 +31,11 @@ class DiagnosticsCollector:
         from .. import __version__
 
         holder = self.server.holder
-        with holder._lock:  # schema dicts mutate under this lock
-            indexes = list(holder.indexes.values())
-            fields = [f for i in indexes for f in list(i.fields.values())]
+        # schema levels mutate under per-object locks; each list()/len()
+        # below is a single GIL-atomic snapshot, so concurrent DDL can
+        # skew counts but never break iteration
+        indexes = list(holder.indexes.values())
+        fields = [f for i in indexes for f in list(i.fields.values())]
         n_fields = len(fields)
         n_frags = sum(len(v.fragments) for f in fields
                       for v in list(f.views.values()))
@@ -59,7 +61,8 @@ class DiagnosticsCollector:
             req = urllib.request.Request(
                 self.endpoint, data=body, method="POST",
                 headers={"Content-Type": "application/json"})
-            urllib.request.urlopen(req, timeout=10).read()
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                resp.read()
             return True
         except Exception as e:
             # diagnostics must never take the server down, but a
