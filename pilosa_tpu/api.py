@@ -15,7 +15,6 @@ import numpy as np
 from . import __version__
 from .core import SHARD_WIDTH
 from .executor import Executor
-from .pql import parse
 from .storage import FieldOptions, Holder
 from .utils.stats import StatsClient
 

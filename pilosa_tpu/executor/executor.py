@@ -16,10 +16,10 @@ import numpy as np
 
 from ..core import SHARD_WIDTH, VIEW_STANDARD
 from ..ops import bitset, bsi
-from ..pql import Call, Query, parse
+from ..pql import Call, parse
 from ..storage.field import FIELD_TYPE_INT, FIELD_TYPE_BOOL
 from ..storage import time_quantum as tq
-from .plan import PlanCompiler, PlanError, Resolver, parametrize
+from .plan import PlanCompiler, Resolver, parametrize
 from .results import (
     FieldRow, GroupCount, Pair, RowIdentifiers, RowResult, ValCount,
     acc_counts, rank_counts, sort_pairs,

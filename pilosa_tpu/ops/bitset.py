@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import SHARD_WIDTH, SHARD_WORDS, WORD_BITS, WORD_BITS_EXP
+from ..core import SHARD_WORDS, WORD_BITS, WORD_BITS_EXP
 
 _FULL_WORD = np.uint32(0xFFFFFFFF)
 

@@ -41,7 +41,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import SHARD_WORDS
-from ..ops import bitset, bsi
+from ..ops import bsi
 from ..executor.plan import eval_plan, parametrize, plan_inputs
 
 SHARD_AXIS = "shards"

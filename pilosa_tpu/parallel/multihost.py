@@ -29,7 +29,6 @@ over an N-device mesh.
 
 from __future__ import annotations
 
-import numpy as np
 
 
 def init_distributed(coordinator: str, num_processes: int,

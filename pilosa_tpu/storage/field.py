@@ -12,7 +12,7 @@ from __future__ import annotations
 import json
 import os
 import threading
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass
 from datetime import datetime
 
 import numpy as np
@@ -22,7 +22,7 @@ from ..core import (
     VIEW_BSI_GROUP_PREFIX,
     VIEW_STANDARD,
 )
-from ..ops import bitset, bsi
+from ..ops import bsi
 from .attrs import AttrStore
 from . import time_quantum as tq
 from .view import View

@@ -9,7 +9,7 @@ import threading
 
 import numpy as np
 
-from ..core import EXISTENCE_FIELD_NAME, SHARD_WIDTH, VIEW_STANDARD
+from ..core import EXISTENCE_FIELD_NAME, VIEW_STANDARD
 from .attrs import AttrStore
 from .field import Field, FieldOptions, FIELD_TYPE_SET, CACHE_TYPE_NONE
 
