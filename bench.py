@@ -455,20 +455,30 @@ def cpu_config4(holder, meta, rng, n=2):
 
 
 def bench_http(server_port, rng, n_rows):
-    """Config 2 through the real HTTP surface: concurrent POSTs (the
-    ThreadingHTTPServer overlaps request threads the same way the engine
-    bench overlaps client threads)."""
+    """Config 2 through the real HTTP surface: concurrent POSTs over
+    per-thread keep-alive connections (the ThreadingHTTPServer overlaps
+    request threads the same way the engine bench overlaps client
+    threads)."""
     import http.client
+    import threading
 
     B, n_batches, T = 256, 24, 8
+    local = threading.local()
 
     def post(body):
-        conn = http.client.HTTPConnection("localhost", server_port,
-                                          timeout=120)
-        conn.request("POST", "/index/startrace/query", body=body.encode())
-        resp = conn.getresponse()
-        data = resp.read()
-        conn.close()
+        conn = getattr(local, "conn", None)
+        if conn is None:
+            conn = local.conn = http.client.HTTPConnection(
+                "localhost", server_port, timeout=120)
+        try:
+            conn.request("POST", "/index/startrace/query",
+                         body=body.encode())
+            resp = conn.getresponse()
+            data = resp.read()
+        except (OSError, http.client.HTTPException):
+            conn.close()
+            local.conn = None
+            raise
         assert resp.status == 200, data
         return data
 

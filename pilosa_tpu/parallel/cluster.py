@@ -129,6 +129,22 @@ class InternalClient:
     def __init__(self, timeout: float = 30.0):
         self.timeout = timeout
         self._ssl_ctx = None
+        # per-thread keep-alive connections (the server speaks HTTP/1.1):
+        # a cluster fan-out must not pay a TCP handshake per sub-query
+        self._local = threading.local()
+        # every pooled connection also registers here so close() can
+        # release sockets owned by other threads' pools
+        self._all_conns: set = set()
+        self._conns_lock = threading.Lock()
+
+    def close(self):
+        with self._conns_lock:
+            conns, self._all_conns = self._all_conns, set()
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
 
     def configure_tls(self, cert: str, key: str, ca: str | None,
                       skip_verify: bool = False):
@@ -143,32 +159,69 @@ class InternalClient:
             ctx.verify_mode = ssl.CERT_NONE
         self._ssl_ctx = ctx
 
-    def _request(self, host: str, method: str, path: str,
-                 body: bytes | None = None,
-                 ctype: str = "application/json",
-                 timeout: float | None = None) -> tuple[int, bytes]:
+    def _new_conn(self, host: str, timeout: float):
         https = host.startswith("https://")
-        host = host.removeprefix("https://").removeprefix("http://")
-        h, _, p = host.rpartition(":")
+        hostport = host.removeprefix("https://").removeprefix("http://")
+        h, _, p = hostport.rpartition(":")
         if https:
             import ssl
             # no configured client context -> default VERIFIED context
             # (never silently skip verification; skip-verify is an
             # explicit configure_tls option)
-            conn = http.client.HTTPSConnection(
-                h or "localhost", int(p), timeout=timeout or self.timeout,
+            return http.client.HTTPSConnection(
+                h or "localhost", int(p), timeout=timeout,
                 context=self._ssl_ctx or ssl.create_default_context())
-        else:
-            conn = http.client.HTTPConnection(
-                h or "localhost", int(p), timeout=timeout or self.timeout)
-        try:
-            headers = {"Content-Type": ctype,
-                       "Content-Length": str(len(body or b""))}
-            conn.request(method, path, body=body, headers=headers)
-            resp = conn.getresponse()
-            return resp.status, resp.read()
-        finally:
+        return http.client.HTTPConnection(h or "localhost", int(p),
+                                          timeout=timeout)
+
+    def _request(self, host: str, method: str, path: str,
+                 body: bytes | None = None,
+                 ctype: str = "application/json",
+                 timeout: float | None = None) -> tuple[int, bytes]:
+        timeout = timeout or self.timeout
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            conns = self._local.conns = {}
+        headers = {"Content-Type": ctype,
+                   "Content-Length": str(len(body or b""))}
+
+        def drop(conn):
             conn.close()
+            conns.pop(host, None)
+            with self._conns_lock:
+                self._all_conns.discard(conn)
+
+        # One reconnect retry, ONLY when a POOLED connection fails during
+        # SEND — the stale-keep-alive case, where the request provably
+        # never reached the peer.  A fresh-connection failure must not
+        # retry (it would double every timeout against a dead node), and
+        # a response-phase failure must not retry (the peer may have
+        # executed a non-idempotent request already).
+        for attempt in (0, 1):
+            conn = conns.get(host)
+            reused = conn is not None
+            if conn is None:
+                conn = conns[host] = self._new_conn(host, timeout)
+                with self._conns_lock:
+                    self._all_conns.add(conn)
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+            except (OSError, http.client.HTTPException):
+                drop(conn)
+                if reused and attempt == 0:
+                    continue
+                raise
+            try:
+                resp = conn.getresponse()
+                data = resp.read()
+            except (OSError, http.client.HTTPException):
+                drop(conn)
+                raise
+            if resp.will_close:
+                drop(conn)
+            return resp.status, data
 
     def _json(self, host, method, path, obj=None, timeout=None):
         body = None if obj is None else json.dumps(obj).encode()
@@ -449,6 +502,7 @@ class Cluster:
     def close(self):
         self._closing.set()
         self._pool.shutdown(wait=False)
+        self.client.close()
 
     @property
     def local(self) -> Node:
