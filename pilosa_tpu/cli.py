@@ -218,6 +218,37 @@ def cmd_generate_config(args) -> int:
     return 0
 
 
+def cmd_config(args) -> int:
+    """Print the RESOLVED configuration after the TOML < env < flag
+    cascade (reference `pilosa config`, cmd/config.go)."""
+    from .server.server import Config
+
+    cfg = Config.from_toml(args.config) if args.config else \
+        Config.from_env()
+    q = json.dumps  # JSON string syntax is valid TOML basic-string syntax
+    print(f"data-dir = {q(cfg.data_dir)}")
+    print(f"bind = {q(cfg.bind)}")
+    print(f"max-op-n = {cfg.max_op_n}")
+    print(f"max-row-id = {cfg.max_row_id}")
+    print(f"use-mesh = {str(cfg.use_mesh).lower()}")
+    print(f"device-budget-mb = {cfg.device_budget_mb}")
+    print()
+    print("[cluster]")
+    print(f"hosts = [{', '.join(q(h) for h in cfg.cluster_hosts)}]")
+    print(f"replicas = {cfg.replica_n}")
+    print()
+    print("[anti-entropy]")
+    print(f"interval = {cfg.anti_entropy_interval}")
+    if cfg.tls_certificate:
+        print()
+        print("[tls]")
+        print(f"certificate = {q(cfg.tls_certificate)}")
+        print(f"key = {q(cfg.tls_key)}")
+        if cfg.tls_ca_certificate:
+            print(f"ca-certificate = {q(cfg.tls_ca_certificate)}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="pilosa-tpu",
@@ -270,8 +301,20 @@ def main(argv=None) -> int:
     sp = sub.add_parser("generate-config", help="print default config")
     sp.set_defaults(fn=cmd_generate_config)
 
+    sp = sub.add_parser("config",
+                        help="print the resolved configuration")
+    sp.add_argument("-c", "--config", help="TOML config file")
+    sp.set_defaults(fn=cmd_config)
+
     args = p.parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # stdout piped into a closed reader (e.g. `| head`): standard
+        # CLI behavior is to exit quietly
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
 
 
 if __name__ == "__main__":

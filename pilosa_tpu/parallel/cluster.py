@@ -199,8 +199,13 @@ class InternalClient:
         # executed a non-idempotent request already).
         for attempt in (0, 1):
             conn = conns.get(host)
-            reused = conn is not None
-            if conn is None:
+            # a pooled entry whose socket is gone (client.close() raced a
+            # fan-out thread) is NOT a live keep-alive: replace it so it
+            # re-registers and gets fresh-connection (no-retry) semantics
+            reused = conn is not None and conn.sock is not None
+            if conn is None or conn.sock is None:
+                if conn is not None:
+                    drop(conn)
                 conn = conns[host] = self._new_conn(host, timeout)
                 with self._conns_lock:
                     self._all_conns.add(conn)
@@ -218,6 +223,12 @@ class InternalClient:
                 data = resp.read()
             except (OSError, http.client.HTTPException):
                 drop(conn)
+                # a FIN'd keep-alive often fails only here (the send
+                # lands in the kernel buffer); GETs are idempotent, so
+                # they get the reconnect retry — POSTs may have executed
+                # on the peer and must not resend
+                if reused and attempt == 0 and method == "GET":
+                    continue
                 raise
             if resp.will_close:
                 drop(conn)

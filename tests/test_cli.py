@@ -98,6 +98,27 @@ def test_check_and_inspect(tmp_path, capsys):
     assert "CORRUPT" in capsys.readouterr().out
 
 
+def test_config_resolved(tmp_path, capsys, monkeypatch):
+    """`config` prints the resolved cascade: TOML overridden by env."""
+    toml = tmp_path / "c.toml"
+    toml.write_text('data-dir = "/tmp/x"\nbind = "localhost:7777"\n')
+    monkeypatch.setenv("PILOSA_TPU_BIND", "localhost:8888")
+    rc = main(["config", "-c", str(toml)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert 'data-dir = "/tmp/x"' in out       # from TOML
+    assert 'bind = "localhost:8888"' in out   # env wins over TOML
+    assert "[cluster]" in out
+    # round-trips: the printed output parses as the same config
+    rt = tmp_path / "rt.toml"
+    rt.write_text(out)
+    monkeypatch.delenv("PILOSA_TPU_BIND")
+    from pilosa_tpu.server.server import Config
+    cfg = Config.from_toml(str(rt))
+    assert cfg.bind == "localhost:8888"
+    assert cfg.data_dir == "/tmp/x"
+
+
 def test_generate_config(capsys):
     assert main(["generate-config"]) == 0
     out = capsys.readouterr().out
