@@ -84,7 +84,7 @@ class _PendingGroup:
         op (shared by the grouped executor and the prepared cache)."""
         nB = len(call_idxs)
         return cls(parts, call_idxs,
-                   lambda hp: ([int(x) for x in np.sum(hp, axis=0)]
+                   lambda hp: (np.sum(hp, axis=0).tolist()
                                if hp else [0] * nB))
 
 

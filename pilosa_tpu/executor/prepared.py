@@ -45,6 +45,7 @@ from collections import OrderedDict
 import numpy as np
 
 from ..core import schema_epoch
+from ..native import fingerprint_native
 from ..ops import bsi
 from ..pql import parse
 from ..pql.ast import LitInt, Query
@@ -66,9 +67,28 @@ _FP = re.compile(
 
 
 def fingerprint(query: str):
-    """(template, values): the query text with int literals replaced by
-    '?' and the literal values in source order.  Vectorized: one regex
-    split, list slicing, one join — no per-match Python callback."""
+    """(template, values list): the query text with int literals replaced
+    by '?' and the literal values in source order."""
+    template, values = _fingerprint_fast(query)
+    if isinstance(values, np.ndarray):
+        values = values.tolist()
+    return template, values
+
+
+def _fingerprint_fast(query: str):
+    """Hot-path variant: values may come back as an int64 ndarray (C
+    scanner, native/fingerprint.c — memory-speed) or a list of Python
+    ints (regex fallback: non-ASCII text, int64 overflow, missing
+    toolchain).  Internal because ndarray values break ``==`` users."""
+    native = fingerprint_native(query)
+    if native is not None:
+        return native
+    return _fingerprint_py(query)
+
+
+def _fingerprint_py(query: str):
+    """Pure-Python fingerprint: one regex split, list slicing, one join —
+    no per-match Python callback."""
     parts = _FP.split(query)
     if len(parts) == 1:
         return query, []
@@ -248,14 +268,23 @@ class PreparedCache:
         (True, results) on a hit; (False, parsed_query_or_None) on a miss
         — the parsed AST (literal-tagged, tags invisible to the classic
         path) is handed back so the caller never parses twice."""
-        template, values = fingerprint(query)
+        template, values = _fingerprint_fast(query)
         key = (index, template)
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:
                 self._entries.move_to_end(key)
-        vals = np.asarray(values, dtype=np.int64) if values else \
-            np.zeros(0, dtype=np.int64)
+        if isinstance(values, np.ndarray):
+            vals = values
+        else:
+            try:
+                vals = np.asarray(values, dtype=np.int64) if values else \
+                    np.zeros(0, dtype=np.int64)
+            except OverflowError:
+                # a literal beyond int64 can't ride the params machinery;
+                # the classic path (arbitrary-precision ints) owns it
+                self.misses += 1
+                return False, None
 
         if entry is _UNCACHEABLE:
             self.misses += 1
@@ -276,7 +305,8 @@ class PreparedCache:
         self.misses += 1
         spans = fingerprint_spans(query)
         q = parse(query, mkint=lambda v, s: (
-            LitInt(v, spans[s], v - values[spans[s]]) if s in spans else v))
+            LitInt(v, spans[s], v - int(values[spans[s]]))
+            if s in spans else v))
         entry = self._prepare(index, q, values)
         with self._lock:
             self._entries[key] = entry if entry is not None else _UNCACHEABLE

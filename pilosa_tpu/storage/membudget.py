@@ -35,32 +35,57 @@ class DeviceBudget:
     def resident_bytes(self) -> int:
         return self._total
 
-    def register(self, key: tuple, nbytes: int, evict: Callable[[], None]):
-        """Account ``nbytes`` under ``key``; ``evict`` drops the owner's
-        reference when called.  Evicts LRU entries first if needed.
-        Eviction callbacks run OUTSIDE the budget lock so owners may take
-        their own locks without ordering against this one."""
+    def _evict_lru_locked(self, incoming: int) -> list[Callable[[], None]]:
+        """Pop LRU entries until ``incoming`` more bytes fit the limit;
+        returns their callbacks for the caller to run OUTSIDE the lock
+        (owners may take their own locks without ordering against this
+        one).  Caller must hold self._lock."""
         to_evict: list[Callable[[], None]] = []
-        with self._lock:
-            old = self._entries.pop(key, None)
-            if old is not None:
-                self._total -= old[0]
-            if self.limit_bytes is not None:
-                # evict until the new entry fits (never evicting itself)
-                while self._entries and \
-                        self._total + nbytes > self.limit_bytes:
-                    _, (freed, cb) = self._entries.popitem(last=False)
-                    self._total -= freed
-                    self.evictions += 1
-                    to_evict.append(cb)
-            self._entries[key] = (nbytes, evict)
-            self._total += nbytes
-            self._peak = max(self._peak, self._total)
+        if self.limit_bytes is not None:
+            while self._entries and \
+                    self._total + incoming > self.limit_bytes:
+                _, (freed, cb) = self._entries.popitem(last=False)
+                self._total -= freed
+                self.evictions += 1
+                to_evict.append(cb)
+        return to_evict
+
+    @staticmethod
+    def _run_evictions(to_evict: list[Callable[[], None]]):
         for cb in to_evict:
             try:
                 cb()
             except Exception:
                 pass
+
+    def register(self, key: tuple, nbytes: int, evict: Callable[[], None]):
+        """Account ``nbytes`` under ``key``; ``evict`` drops the owner's
+        reference when called.  Evicts LRU entries first if needed (never
+        evicting the incoming entry itself)."""
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._total -= old[0]
+            to_evict = self._evict_lru_locked(nbytes)
+            self._entries[key] = (nbytes, evict)
+            self._total += nbytes
+            self._peak = max(self._peak, self._total)
+        self._run_evictions(to_evict)
+
+    def reset_peak(self):
+        """Restart the high-water mark from the current residency (bench /
+        diagnostics epochs; the gauge analog of prometheus' counter
+        resets)."""
+        with self._lock:
+            self._peak = self._total
+
+    def shrink_to_limit(self):
+        """Evict LRU entries until residency fits the (possibly just
+        lowered) limit — ``register`` only evicts on new allocations, so a
+        runtime limit decrease applies lazily without this."""
+        with self._lock:
+            to_evict = self._evict_lru_locked(0)
+        self._run_evictions(to_evict)
 
     def touch(self, key: tuple):
         with self._lock:

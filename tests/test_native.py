@@ -1,0 +1,96 @@
+"""Native fingerprint scanner: exact equivalence with the Python regex.
+
+The C scanner (native/fingerprint.c) sits in front of the prepared-
+statement cache on every request; any divergence from the regex
+(prepared._FP) would silently mis-key the cache or mis-extract literals,
+so it is differential-fuzzed against the Python path (the same oracle
+pattern as tests/test_fuzz.py; reference roaring/fuzzer.go:28).
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.executor.prepared import _fingerprint_py, fingerprint
+from pilosa_tpu.native import fingerprint_native
+
+
+def _native_or_skip(q):
+    out = fingerprint_native(q)
+    if out is None and fingerprint_native("probe") is None:
+        pytest.skip("native fingerprint library unavailable")
+    return out
+
+
+def test_native_builds_and_matches_basic():
+    q = "Count(Row(stargazer=14)) TopN(language, Row(stars=-3), n=50)"
+    nat = _native_or_skip(q)
+    assert nat is not None
+    t, v = nat
+    pt, pv = _fingerprint_py(q)
+    assert t == pt
+    assert [int(x) for x in v] == pv
+
+
+def test_native_quotes_timestamps_floats():
+    cases = [
+        "Row(f='ab12cd') Row(g=\"9\") Sum(Row(v > 123456), field=v)",
+        "Range(v > 2017-01-01T00:00)",
+        "Row(f=1.5) Row(g=field1) Row(h=1a2b)",
+        "Set(100, f=2)",
+        "Row(f='unterminated 12",
+        "Row(f='esc\\'aped 7') Count(Row(g=8))",
+    ]
+    for q in cases:
+        nat = _native_or_skip(q)
+        assert nat is not None, q
+        pt, pv = _fingerprint_py(q)
+        assert nat[0] == pt, q
+        assert [int(x) for x in nat[1]] == pv, q
+
+
+def test_native_overflow_falls_back():
+    q = "Row(x=99999999999999999999)"
+    assert fingerprint_native(q) is None or \
+        fingerprint_native("probe") is None
+    # the public fingerprint() still answers via the regex path
+    t, v = fingerprint(q)
+    assert t == "Row(x=?)"
+    assert list(v) == [99999999999999999999]
+
+
+def test_overflow_literal_reaches_classic_path():
+    """A >int64 literal must not blow up inside the prepared cache's
+    int64 params coercion (r5 review: OverflowError escaped execute());
+    it falls through to the classic path, which reports a clean query
+    error."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.pql.parser import ParseError
+    from pilosa_tpu.storage import Holder
+
+    h = Holder(None)
+    idx = h.create_index("ovf", track_existence=False)
+    idx.create_field("f")
+    ex = Executor(h, use_mesh=True)
+    with pytest.raises(ParseError):
+        ex.execute("ovf", "Count(Row(f=99999999999999999999))")
+
+
+def test_native_non_ascii_falls_back():
+    # \w matches Unicode word chars in the regex; the byte-wise scanner
+    # must decline rather than diverge
+    assert fingerprint_native("Row(f=Ă 9)") is None
+
+
+def test_native_differential_fuzz():
+    if fingerprint_native("probe") is None:
+        pytest.skip("native fingerprint library unavailable")
+    rng = np.random.default_rng(11)
+    alphabet = list("abzAZ019_.:-'\"\\()=<>, \tRow(stargazer=)Count")
+    for _ in range(4000):
+        n = int(rng.integers(0, 60))
+        s = "".join(rng.choice(alphabet) for _ in range(n))
+        py_t, py_v = _fingerprint_py(s)
+        nat = fingerprint_native(s)
+        assert nat is not None, s
+        assert nat[0] == py_t, repr(s)
+        assert [int(x) for x in nat[1]] == py_v, repr(s)
