@@ -330,10 +330,12 @@ class API:
         nodes = [{"id": "node0", "uri": "", "isCoordinator": True,
                   "state": "READY"}]
         state = STATE_NORMAL
+        epoch = 0
         if self.cluster is not None:
             nodes = self.cluster.node_statuses()
             state = self.cluster.state
-        return {"state": state, "nodes": nodes,
+            epoch = self.cluster.epoch
+        return {"state": state, "nodes": nodes, "epoch": epoch,
                 "localID": nodes[0]["id"] if self.cluster is None
                 else self.cluster.node_id}
 

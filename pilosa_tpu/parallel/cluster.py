@@ -33,7 +33,9 @@ from __future__ import annotations
 import base64
 import http.client
 import json
+import os
 import threading
+import time
 import zlib
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any
@@ -497,6 +499,11 @@ class Cluster:
         self._closing = threading.Event()
         self._health_thread = None
         self._resize_lock = threading.Lock()
+        # membership epoch: bumped by every completed resize, persisted in
+        # .topology, carried on resize-complete messages so retries are
+        # idempotent and stale nodes are detectable by probe
+        self.epoch = 0
+        self._load_topology()
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * len(self.nodes)))
 
@@ -505,6 +512,8 @@ class Cluster:
     def open(self, api):
         self.api = api
         self.state = STATE_NORMAL
+        if self.is_coordinator:
+            self._recover_resize_job()
         if self.health_interval > 0:
             self._health_thread = threading.Thread(
                 target=self._monitor_health, daemon=True)
@@ -542,11 +551,39 @@ class Cluster:
         for n in self.peers():
             was_down = n.state == NODE_DOWN
             try:
-                self.client.status(n.host)
+                st = self.client.status(n.host)
                 n.state = NODE_READY
             except Exception:
                 n.state = NODE_DOWN
                 continue
+            peer_epoch = st.get("epoch")
+            if (self.is_coordinator and peer_epoch is not None
+                    and peer_epoch < self.epoch):
+                # straggler on an older membership (missed a
+                # resize-complete): re-push the current one, epoch-gated
+                try:
+                    self.client.send_message(n.host, {
+                        "type": "resize-complete",
+                        "membership": self._membership(),
+                        "replicaN": self.replica_n,
+                        "epoch": self.epoch})
+                except Exception:
+                    n.state = NODE_DOWN
+                    continue
+            if (not self.is_coordinator and n.id == self.nodes[0].id
+                    and self.state == STATE_RESIZING
+                    and st.get("state") != STATE_RESIZING):
+                coord_members = {d.get("id") for d in st.get("nodes", [])}
+                if self.node_id not in coord_members and peer_epoch:
+                    # that resize REMOVED us and its revert notification
+                    # never arrived: adopt the single-node view ourselves
+                    self._apply_resize_complete({
+                        "membership": st.get("nodes", []),
+                        "replicaN": 1, "epoch": peer_epoch})
+                elif peer_epoch is None or peer_epoch <= self.epoch:
+                    # the resize that latched us RESIZING died with its
+                    # coordinator (no job record survived); unlatch
+                    self.state = STATE_NORMAL
             if was_down:
                 # Schema catch-up: a node that was DOWN during a DDL
                 # broadcast missed it permanently (broadcast skips DOWN
@@ -560,6 +597,12 @@ class Cluster:
                     })
                 except Exception:
                     n.state = NODE_DOWN
+        # an outstanding resize job whose members are all current resolves
+        job = self._load_resize_job()
+        if (job is not None and self.is_coordinator
+                and job.get("epoch", 0) <= self.epoch
+                and all(n.state == NODE_READY for n in self.peers())):
+            self._clear_resize_job()
         self._update_state()
 
     def _update_state(self):
@@ -1313,6 +1356,130 @@ class Cluster:
     def _membership(self) -> list[dict]:
         return [{"id": n.id, "uri": n.host} for n in self.nodes]
 
+    # -- topology persistence (cluster.go:1580-1692 Topology,
+    #    considerTopology) -------------------------------------------------
+
+    def _topology_path(self) -> str | None:
+        base = getattr(self.holder, "path", None) if self.holder else None
+        return os.path.join(base, ".topology") if base else None
+
+    def _resize_job_path(self) -> str | None:
+        base = getattr(self.holder, "path", None) if self.holder else None
+        return os.path.join(base, ".resize_job") if base else None
+
+    def _load_topology(self):
+        """Adopt persisted membership over the config host list (the
+        reference reconciles its .topology protobuf the same way at
+        startup; a restart after a live resize must not silently revert
+        to the config file and split-brain the cluster)."""
+        path = self._topology_path()
+        if path is None or not os.path.exists(path):
+            return
+        with open(path) as f:
+            data = json.load(f)
+        membership = data.get("membership") or []
+        if not membership:
+            return
+        if self.node_id not in {m["id"] for m in membership}:
+            # the considerTopology mismatch case: disk says this node is
+            # not a member — refuse to start rather than serve a placement
+            # the rest of the cluster doesn't share (operator removes
+            # .topology to deliberately re-seed from config)
+            raise ClusterError(
+                f"node {self.node_id!r} is not in the persisted topology "
+                f"{path} (members: {[m['id'] for m in membership]}); "
+                f"remove the file to re-seed membership from config")
+        self.nodes = [Node(m["id"], m["uri"]) for m in membership]
+        self.by_id = {n.id: n for n in self.nodes}
+        self.replica_n = int(data.get("replicaN", self.replica_n))
+        self.epoch = int(data.get("epoch", 0))
+        self.placement = Placement([n.id for n in self.nodes],
+                                   replica_n=self.replica_n,
+                                   hasher=self.placement.hasher)
+
+    def _save_topology(self):
+        path = self._topology_path()
+        if path is None:
+            return
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"epoch": self.epoch, "replicaN": self.replica_n,
+                       "membership": self._membership()}, f)
+        os.replace(tmp, path)
+
+    # -- resize job record (cluster.go:1413-1441 resizeJob): persisted on
+    #    the coordinator between phase 1 and 2 so a crash mid-completion
+    #    can be re-driven instead of diverging ---------------------------
+
+    def _save_resize_job(self, job: dict):
+        path = self._resize_job_path()
+        if path is None:
+            return
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(job, f)
+        os.replace(tmp, path)
+
+    def _load_resize_job(self) -> dict | None:
+        path = self._resize_job_path()
+        if path is None or not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except Exception:
+            return None
+
+    def _clear_resize_job(self):
+        path = self._resize_job_path()
+        if path is not None and os.path.exists(path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _recover_resize_job(self):
+        """Coordinator startup: an on-disk job means a crash happened
+        after phase 1 (data fetched) but before every member acked
+        resize-complete.  Completion is the only safe direction — fetched
+        data is a superset, while reverting would need an inverse copy —
+        so re-drive phase 2 idempotently (epoch-gated on receivers)."""
+        job = self._load_resize_job()
+        if job is None:
+            return
+        epoch = job.get("epoch", self.epoch + 1)
+        done_msg = {"type": "resize-complete",
+                    "membership": job["membership"],
+                    "replicaN": job.get("replicaN", self.replica_n),
+                    "epoch": epoch}
+        ok = True
+        # short per-send timeout: this runs inside Server.open(), and an
+        # unreachable member must not stall startup for the default 30s
+        # each — probe reconciliation re-pushes on the health cadence
+        for m in job["membership"]:
+            if m["id"] == self.node_id:
+                continue
+            try:
+                self.client.send_message(m["uri"], done_msg, timeout=5.0)
+            except Exception:
+                ok = False  # probe reconciliation keeps pushing
+        self.handle_message(done_msg)
+        # nodes the interrupted resize was removing still need their
+        # single-node revert, or they stay latched RESIZING forever (the
+        # probe safety net in probe_peers also covers this)
+        for m in job.get("removed", []):
+            try:
+                self.client.send_message(m["uri"], {
+                    "type": "resize-complete",
+                    "membership": [m], "replicaN": 1, "epoch": epoch},
+                    timeout=5.0)
+            except Exception:
+                ok = False
+        if ok:
+            self._clear_resize_job()
+
     def resize_add_node(self, node_id: str, host: str):
         """(api.go:1226-ish AddNode analog; coordinator only)"""
         if not self.is_coordinator:
@@ -1358,6 +1525,11 @@ class Cluster:
         # fragments are in flight; an aborted resize restores NORMAL below
         participants = {n.id: n.host for n in self.nodes}
         participants.update(hosts)
+        # latch our own state FIRST: a peer probing mid-notify must see
+        # the coordinator RESIZING, or its stale-latch safety valve
+        # (probe_peers) would unlatch it during phase-1 fetch and let
+        # writes land on fragments already copied away (r5 review)
+        self.state = STATE_RESIZING
         for nid, host in participants.items():
             if nid != self.node_id:
                 try:
@@ -1366,7 +1538,6 @@ class Cluster:
                                "state": STATE_RESIZING})
                 except Exception:
                     pass  # DOWN old member; fetch sources skip it anyway
-        self.state = STATE_RESIZING
         completed = False
         try:
             # per-node fetch lists: (index, shard) pairs the node will own
@@ -1404,17 +1575,37 @@ class Cluster:
                         self.RESIZE_FETCH_TIMEOUT))
             for f in futs:
                 f.result()  # any fetch failure aborts before data loss
-            # phase 2: everyone switches placement + cleans
+            # Point of no return: persist the job record BEFORE any node
+            # adopts the new membership (cluster.go:1413 resizeJob).  From
+            # here the resize only moves forward — fetched data is a
+            # superset, so completion is always safe, while a partial
+            # completion with no record could never reconverge.
+            new_epoch = self.epoch + 1
+            self._save_resize_job({
+                "epoch": new_epoch, "membership": new_membership,
+                "replicaN": self.replica_n,
+                "removed": [{"id": n.id, "uri": n.host} for n in removed]})
+            completed = True  # phase-1 abort path no longer applies
+            # phase 2: peers adopt FIRST, with retries; the coordinator
+            # adopts only after every peer acked (r4 advisor: adopting
+            # locally before peer acks made a failed peer permanently
+            # diverge, and the retry raised 'already in cluster').
             done_msg = {"type": "resize-complete",
                         "membership": new_membership,
-                        "replicaN": self.replica_n}
-            futs = [self._pool.submit(self.client.send_message,
-                                      hosts[nid], done_msg)
-                    for nid in new_ids if nid != self.node_id]
+                        "replicaN": self.replica_n,
+                        "epoch": new_epoch}
+            unacked = {nid for nid in new_ids if nid != self.node_id}
+            for _ in range(3):
+                for nid in sorted(unacked):
+                    try:
+                        self.client.send_message(hosts[nid], done_msg)
+                        unacked.discard(nid)
+                    except Exception:
+                        pass
+                if not unacked:
+                    break
+                time.sleep(0.2)
             self.handle_message(done_msg)
-            for f in futs:
-                f.result()
-            completed = True
             # a gracefully removed node reverts to a single-node cluster
             # view of itself; best-effort notification
             for n in removed:
@@ -1422,14 +1613,23 @@ class Cluster:
                     self.client.send_message(n.host, {
                         "type": "resize-complete",
                         "membership": [{"id": n.id, "uri": n.host}],
-                        "replicaN": 1})
+                        "replicaN": 1, "epoch": new_epoch})
                 except Exception:
                     pass
+            if unacked:
+                # keep the job record: probe reconciliation (and a
+                # restart's _recover_resize_job) re-push resize-complete,
+                # epoch-gated, until the stragglers converge
+                for nid in unacked:
+                    self._mark_down(nid)
+            else:
+                self._clear_resize_job()
         finally:
             if not completed:
-                # abort: restore every participant to NORMAL under the OLD
-                # membership — no node dropped data in phase 1, so the
-                # cluster simply resumes and the resize can be retried
+                # abort (phase 1 failed): restore every participant to
+                # NORMAL under the OLD membership — no node dropped data
+                # in phase 1, so the cluster simply resumes and the resize
+                # can be retried
                 for nid, host in participants.items():
                     if nid != self.node_id:
                         try:
@@ -1471,7 +1671,16 @@ class Cluster:
                 frag.bulk_import(rows, cols)
 
     def _apply_resize_complete(self, msg: dict):
-        """Phase 2: adopt the new membership and GC unowned fragments."""
+        """Phase 2: adopt the new membership and GC unowned fragments.
+        Epoch-gated: a duplicate/re-driven resize-complete (coordinator
+        retry, crash recovery, probe reconciliation) for an epoch we
+        already hold is an idempotent no-op ack."""
+        msg_epoch = int(msg.get("epoch", self.epoch + 1))
+        if msg_epoch <= self.epoch:
+            if self.state == STATE_RESIZING:
+                self.state = STATE_NORMAL
+                self._update_state()
+            return
         membership = msg["membership"]
         self.replica_n = msg.get("replicaN", self.replica_n)
         if self.node_id not in {m["id"] for m in membership}:
@@ -1486,6 +1695,8 @@ class Cluster:
                                    replica_n=self.replica_n,
                                    hasher=self.placement.hasher)
         self._holder_cleaner()
+        self.epoch = msg_epoch
+        self._save_topology()
         self.state = STATE_NORMAL
         self._update_state()
 
