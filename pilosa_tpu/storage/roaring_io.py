@@ -28,11 +28,16 @@ import numpy as np
 from ..core import SHARD_WIDTH, SHARD_WIDTH_EXP
 
 MAGIC = 12348
+# official-roaring interop cookies (roaring.go:5020; the reference's
+# UnmarshalBinary accepts both its own and the official format)
+OFFICIAL_NO_RUNS = 12346
+OFFICIAL_RUNS = 12347
 TYPE_ARRAY = 1
 TYPE_BITMAP = 2
 TYPE_RUN = 3
 
 ARRAY_MAX_SIZE = 4096  # roaring.go:1927
+RUN_MAX_SIZE = 2048    # roaring.go:1930
 
 
 class RoaringFormatError(ValueError):
@@ -60,6 +65,19 @@ def _unpack_roaring(data: bytes, row_id_cap: int | None = None
     if len(data) < 8:
         raise RoaringFormatError("roaring data too short")
     cookie = struct.unpack_from("<I", data, 0)[0]
+    if cookie & 0xFFFF in (OFFICIAL_NO_RUNS, OFFICIAL_RUNS):
+        rows, cols = _unpack_official(data, cookie)
+        # apply the same row-id allocation guard as the pilosa path
+        # (official keys are u16, but configured caps can sit below the
+        # row 4095 a max key implies)
+        if row_id_cap is None:
+            from ..core import DEFAULT_MAX_ROW_ID
+            row_id_cap = DEFAULT_MAX_ROW_ID
+        if rows.size and int(rows.max()) > row_id_cap:
+            raise RoaringFormatError(
+                f"roaring data implies a row id {int(rows.max())} above "
+                f"the configured maximum {row_id_cap}")
+        return rows, cols
     if cookie & 0xFFFF != MAGIC:
         raise RoaringFormatError(
             f"bad roaring cookie: {cookie & 0xFFFF} (want {MAGIC})")
@@ -115,39 +133,129 @@ def _unpack_roaring(data: bytes, row_id_cap: int | None = None
     return pos // SHARD_WIDTH, pos % SHARD_WIDTH
 
 
+def _unpack_official(data: bytes, cookie: int
+                     ) -> tuple[np.ndarray, np.ndarray]:
+    """Official-roaring (32-bit) interop: cookie 12346 (arrays/bitmaps,
+    with offset table) or 12347 (run containers flagged in a bitset) —
+    roaring.go:5024 readOfficialHeader, :1343
+    officialRoaringIterator.Next.  Official run pairs are
+    (start, length-1); pilosa's are (start, last).
+
+    Divergence from the reference, on purpose: per the official spec the
+    runs cookie also carries an offset table once there are
+    NO_OFFSET_THRESHOLD (4) or more containers; the reference assumes
+    run-cookie files are always sequential and would misparse such files
+    from stock CRoaring/Java writers.  Array containers hold up to 4096
+    values INCLUSIVE officially (bitmap only above), where the
+    reference's typer uses a strict <, silently misreading a 4096-card
+    array (8192 bytes) as a bitmap."""
+    NO_OFFSET_THRESHOLD = 4
+    pos_off = 4
+    if cookie & 0xFFFF == OFFICIAL_NO_RUNS:
+        n = struct.unpack_from("<I", data, pos_off)[0]
+        pos_off += 4
+        run_flags = None
+    else:
+        n = (cookie >> 16) + 1
+        flag_bytes = (n + 7) // 8
+        run_flags = np.unpackbits(
+            np.frombuffer(data, dtype=np.uint8, count=flag_bytes,
+                          offset=pos_off), bitorder="little")
+        pos_off += flag_bytes
+    if n > (1 << 16):
+        raise RoaringFormatError(
+            "more than 2^16 containers in official roaring header")
+    headers = np.frombuffer(data, dtype="<u2", count=n * 2,
+                            offset=pos_off).reshape(n, 2)
+    pos_off += n * 4
+    offsets = None
+    if run_flags is None or n >= NO_OFFSET_THRESHOLD:
+        offsets = np.frombuffer(data, dtype="<u4", count=n, offset=pos_off)
+        pos_off += n * 4
+
+    positions = []
+    cur = pos_off
+    for i in range(n):
+        key = int(headers[i, 0])
+        card = int(headers[i, 1]) + 1
+        is_run = run_flags is not None and i < run_flags.size \
+            and run_flags[i]
+        off = int(offsets[i]) if offsets is not None else cur
+        base = np.int64(key) << 16
+        if is_run:
+            run_count = struct.unpack_from("<H", data, off)[0]
+            runs = np.frombuffer(data, dtype="<u2", count=run_count * 2,
+                                 offset=off + 2).reshape(run_count, 2)
+            for start, length1 in runs.astype(np.int64):
+                positions.append(base + np.arange(start,
+                                                  start + length1 + 1))
+            cur = off + 2 + run_count * 4
+        elif card <= ARRAY_MAX_SIZE:
+            vals = np.frombuffer(data, dtype="<u2", count=card, offset=off)
+            positions.append(base + vals.astype(np.int64))
+            cur = off + card * 2
+        else:
+            words = np.frombuffer(data, dtype="<u8", count=1024, offset=off)
+            bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+            positions.append(base + np.nonzero(bits)[0].astype(np.int64))
+            cur = off + 8192
+    if not positions:
+        return (np.zeros(0, dtype=np.int64),) * 2
+    pos = np.concatenate(positions)
+    return pos // SHARD_WIDTH, pos % SHARD_WIDTH
+
+
+def _count_runs(vals: np.ndarray) -> int:
+    """Number of runs in a sorted unique u16 array (roaring.go:2200
+    countRuns)."""
+    if vals.size == 0:
+        return 0
+    return int(np.count_nonzero(np.diff(vals.astype(np.int64)) != 1)) + 1
+
+
 def pack_roaring(rows: np.ndarray, cols: np.ndarray) -> bytes:
-    """Serialize (row, shard-local col) bits to the pilosa-roaring format
-    (array/bitmap containers; runs are valid to read but not emitted,
-    mirroring Optimize()'s conservatism)."""
+    """Serialize (row, shard-local col) bits to the pilosa-roaring format,
+    choosing the cheapest container per key with the reference's optimize
+    heuristic (roaring.go:2232): runs when run count <= RUN_MAX_SIZE and
+    <= N/2, else array when N < ARRAY_MAX_SIZE, else bitmap."""
     rows = np.asarray(rows, dtype=np.int64)
     cols = np.asarray(cols, dtype=np.int64)
     pos = np.unique(rows * SHARD_WIDTH + cols)
     keys = pos >> 16
     low = (pos & 0xFFFF).astype("<u2")
 
-    containers: list[tuple[int, int, np.ndarray | bytes]] = []
+    # (key, type, cardinality, payload)
+    containers: list[tuple[int, int, int, bytes]] = []
     for key in np.unique(keys):
         vals = low[keys == key]
-        if vals.size <= ARRAY_MAX_SIZE:
-            containers.append((int(key), TYPE_ARRAY, vals))
+        n = int(vals.size)
+        n_runs = _count_runs(vals)
+        if n_runs <= RUN_MAX_SIZE and n_runs <= n // 2:
+            v = vals.astype(np.int64)
+            brk = np.nonzero(np.diff(v) != 1)[0]
+            starts = np.concatenate(([v[0]], v[brk + 1]))
+            lasts = np.concatenate((v[brk], [v[-1]]))
+            payload = struct.pack("<H", n_runs) + np.column_stack(
+                (starts, lasts)).astype("<u2").tobytes()
+            containers.append((int(key), TYPE_RUN, n, payload))
+        elif n < ARRAY_MAX_SIZE:
+            containers.append((int(key), TYPE_ARRAY, n, vals.tobytes()))
         else:
             words = np.zeros(1024, dtype="<u8")
             v = vals.astype(np.int64)
             np.bitwise_or.at(words, v >> 6,
                              np.uint64(1) << (v & 63).astype(np.uint64))
-            containers.append((int(key), TYPE_BITMAP, words))
+            containers.append((int(key), TYPE_BITMAP, n, words.tobytes()))
 
     out = bytearray()
     out += struct.pack("<I", MAGIC)
     out += struct.pack("<I", len(containers))
-    for key, ctype, vals in containers:
-        n = vals.size if ctype == TYPE_ARRAY else \
-            int(np.bitwise_count(np.asarray(vals).view(np.uint64)).sum())
+    for key, ctype, n, _ in containers:
         out += struct.pack("<QHH", key, ctype, n - 1)
     offset = 8 + len(containers) * 12 + len(containers) * 4
-    for key, ctype, vals in containers:
+    for _, _, _, payload in containers:
         out += struct.pack("<I", offset)
-        offset += vals.size * 2 if ctype == TYPE_ARRAY else 8192
-    for key, ctype, vals in containers:
-        out += vals.tobytes()
+        offset += len(payload)
+    for _, _, _, payload in containers:
+        out += payload
     return bytes(out)
