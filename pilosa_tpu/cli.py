@@ -29,6 +29,14 @@ def _http(method: str, url: str, body: bytes | None = None,
     return json.loads(data) if data.strip() else {}
 
 
+def _base_url(host: str) -> str:
+    """--host may be bare (``node:10101``) or carry a scheme
+    (``https://node:10101`` for TLS clusters); normalize to a base URL."""
+    host = str(host)
+    scheme, _, bare = host.rpartition("://")
+    return f"{scheme or 'http'}://{bare}"
+
+
 def cmd_server(args) -> int:
     """(ctl/server.go + server/server.go Command.Start)"""
     from .server.server import Config, Server
@@ -59,7 +67,7 @@ def cmd_server(args) -> int:
 def cmd_import(args) -> int:
     """CSV import: row,col[,timestamp] or col,value for -field-type=int
     (ctl/import.go:44-399)."""
-    base = f"http://{args.host}"
+    base = _base_url(args.host)
     if args.create:
         # 409 (already exists) is success for --create ("if missing")
         _http("POST", f"{base}/index/{args.index}",
@@ -123,7 +131,8 @@ def cmd_export(args) -> int:
     """(ctl/export.go:35-112).  Each shard is fetched from a node that
     OWNS it (ctl/export.go fragment-nodes routing) — a single-host fetch
     would silently miss shards placed on other cluster nodes."""
-    base = f"http://{args.host}"
+    base = _base_url(args.host)
+    scheme = base.split("://", 1)[0]
     maxes = _http("GET", f"{base}/internal/shards/max")["standard"]
     max_shard = maxes.get(args.index, 0)
     out = sys.stdout if args.output == "-" else open(args.output, "w")
@@ -133,7 +142,11 @@ def cmd_export(args) -> int:
         hosts = [n["uri"] for n in nodes if n.get("uri")] or [args.host]
         last_err = None
         for host in hosts:  # replica failover: any live owner serves
-            url = (f"http://{host}/export?index={args.index}"
+            # node URIs may already carry a scheme (TLS clusters); bare
+            # hosts inherit the scheme used for args.host
+            h_scheme, _, h_bare = str(host).rpartition("://")
+            url = (f"{h_scheme or scheme}://{h_bare}"
+                   f"/export?index={args.index}"
                    f"&field={args.field}&shard={shard}")
             try:
                 with urllib.request.urlopen(

@@ -130,12 +130,15 @@ def build_router(api: API, server=None) -> Router:
             shards = [int(s) for s in req.query["shards"][0].split(",")]
         results = api.query(args["index"], query, shards)
         out = {"results": [serialize_result(x) for x in results]}
-        col_attrs = []
+        # top-level ColumnAttrSets, deduplicated by column id across the
+        # query's calls like the reference's single set
+        # (http/response.go QueryResponse)
+        col_attrs: dict = {}
         for r in results:
-            col_attrs.extend(getattr(r, "column_attrs", []))
+            for a in getattr(r, "column_attrs", []):
+                col_attrs.setdefault(a.get("id"), a)
         if col_attrs:
-            # top-level ColumnAttrSets (http/response.go QueryResponse)
-            out["columnAttrs"] = col_attrs
+            out["columnAttrs"] = list(col_attrs.values())
         return out
 
     r.add("POST", "/index/{index}/query", post_query)
@@ -238,35 +241,51 @@ def build_router(api: API, server=None) -> Router:
 
     r.add("GET", "/debug/pprof/threads", pprof_threads)
 
+    import threading as _threading
+    profile_lock = _threading.Lock()
+
     def pprof_profile(req, args):
         """Sampling CPU profile: aggregate all-thread stacks at ~100 Hz
-        for ?seconds=N (default 2, max 30); returns collapsed stacks in
-        flamegraph-folded text (one `frame;frame;frame count` per line)."""
+        for ?seconds=N (default 2, clamped to [0.1, 30]); returns
+        collapsed stacks in flamegraph-folded text (one
+        `frame;frame;frame count` per line).  One profile at a time —
+        concurrent requests would each busy-sample every stack and
+        multiply the overhead on a serving node."""
         import sys
         import time as _time
-        seconds = min(float(req.query.get("seconds", ["2"])[0]), 30.0)
+        try:
+            seconds = float(req.query.get("seconds", ["2"])[0])
+        except (TypeError, ValueError):
+            raise ApiError("seconds must be a number")
+        seconds = min(max(seconds, 0.1), 30.0)
+        if not profile_lock.acquire(blocking=False):
+            raise ConflictError("a profile is already running")
         interval = 0.01
-        counts: dict = {}
-        me = __import__("threading").get_ident()
-        deadline = _time.perf_counter() + seconds
-        while _time.perf_counter() < deadline:
-            for tid, frame in sys._current_frames().items():
-                if tid == me:
-                    continue
-                stack = []
-                f = frame
-                while f is not None:
-                    code = f.f_code
-                    stack.append(f"{code.co_name} "
-                                 f"({code.co_filename.rsplit('/', 1)[-1]}"
-                                 f":{f.f_lineno})")
-                    f = f.f_back
-                key = ";".join(reversed(stack))
-                counts[key] = counts.get(key, 0) + 1
-            _time.sleep(interval)
-        lines = [f"{k} {v}" for k, v in
-                 sorted(counts.items(), key=lambda kv: -kv[1])]
-        return ("text/plain", "\n".join(lines))
+        try:
+            counts: dict = {}
+            me = _threading.get_ident()
+            deadline = _time.perf_counter() + seconds
+            while _time.perf_counter() < deadline:
+                for tid, frame in sys._current_frames().items():
+                    if tid == me:
+                        continue
+                    stack = []
+                    f = frame
+                    while f is not None:
+                        code = f.f_code
+                        stack.append(
+                            f"{code.co_name} "
+                            f"({code.co_filename.rsplit('/', 1)[-1]}"
+                            f":{f.f_lineno})")
+                        f = f.f_back
+                    key = ";".join(reversed(stack))
+                    counts[key] = counts.get(key, 0) + 1
+                _time.sleep(interval)
+            lines = [f"{k} {v}" for k, v in
+                     sorted(counts.items(), key=lambda kv: -kv[1])]
+            return ("text/plain", "\n".join(lines))
+        finally:
+            profile_lock.release()
 
     r.add("GET", "/debug/pprof/profile", pprof_profile)
 
@@ -387,9 +406,18 @@ def make_http_server(api: API, host: str = "localhost", port: int = 10101,
 
         def finish_request(self, request, client_address):
             request.settimeout(30)  # bound the handshake
-            request = ctx.wrap_socket(request, server_side=True)
-            request.settimeout(None)
-            super().finish_request(request, client_address)
+            tls_sock = ctx.wrap_socket(request, server_side=True)
+            try:
+                tls_sock.settimeout(None)
+                super().finish_request(tls_sock, client_address)
+            finally:
+                # shutdown_request later runs on the detached raw socket;
+                # close the SSLSocket here so the fd and TLS state are
+                # released deterministically, not on refcount GC
+                try:
+                    tls_sock.close()
+                except OSError:
+                    pass
 
         def handle_error(self, request, client_address):
             # handshake failures (port scans, cert-less clients) are

@@ -245,6 +245,14 @@ class Server:
         self.stats.gauge("runtime.threads", threading.active_count())
         g0, g1, g2 = _gc.get_count()
         self.stats.gauge("runtime.gc_gen0", g0)
+        from ..utils.gcnotify import global_notifier
+        snap = global_notifier().snapshot()
+        for gen in range(3):
+            self.stats.gauge(f"runtime.gc_collections_gen{gen}",
+                             snap["collections"][gen])
+            self.stats.gauge(f"runtime.gc_pause_ms_gen{gen}",
+                             round(snap["pause_s"][gen] * 1e3, 3))
+        self.stats.gauge("runtime.gc_collected", snap["collected"])
         from ..storage.membudget import DEFAULT_BUDGET
         self.stats.gauge("runtime.hbm_resident_bytes",
                          DEFAULT_BUDGET.resident_bytes)

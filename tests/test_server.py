@@ -215,6 +215,60 @@ def test_pprof_and_runtime_stats(srv):
     assert snap["gauges"]["runtime.threads"] >= 1
 
 
+def test_pprof_profile_validates_and_serializes(srv):
+    """?seconds must be validated (garbage was an unhandled 500) and only
+    one profile may run at a time (409 for the second) — r4 advisor."""
+    code, body = call_err(srv, "GET", "/debug/pprof/profile?seconds=abc")
+    assert code == 400 and "seconds" in body["error"]
+
+    import threading
+    results = []
+
+    def profile():
+        try:
+            call(srv, "GET", "/debug/pprof/profile?seconds=1", raw=True)
+            results.append(200)
+        except urllib.error.HTTPError as e:
+            results.append(e.code)
+
+    threads = [threading.Thread(target=profile) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(results) == [200, 409]
+    # the lock is released: a fresh profile succeeds
+    call(srv, "GET", "/debug/pprof/profile?seconds=0.1", raw=True)
+
+
+def test_column_attrs_deduped_across_calls(srv):
+    """Multiple Options(columnAttrs=true) calls over the same column must
+    emit ONE top-level entry (reference's deduplicated ColumnAttrSets) —
+    r4 advisor."""
+    call(srv, "POST", "/index/ca", {})
+    call(srv, "POST", "/index/ca/field/f", {})
+    call(srv, "POST", "/index/ca/query",
+         b'Set(7, f=1) Set(7, f=2) SetColumnAttrs(7, city="pdx")')
+    out = call(srv, "POST", "/index/ca/query",
+               b"Options(Row(f=1), columnAttrs=true) "
+               b"Options(Row(f=2), columnAttrs=true)")
+    assert out["columnAttrs"] == [{"id": 7, "attrs": {"city": "pdx"}}]
+
+
+def test_gcnotify_gauges(srv):
+    """gcnotify.go parity: GC cycle counts and pause totals surface as
+    runtime gauges."""
+    import gc
+
+    from pilosa_tpu.utils.gcnotify import global_notifier
+    before = global_notifier().snapshot()["collections"][2]
+    gc.collect()
+    srv.collect_runtime_stats()
+    snap = call(srv, "GET", "/debug/vars")
+    assert snap["gauges"]["runtime.gc_collections_gen2"] >= before + 1
+    assert "runtime.gc_pause_ms_gen2" in snap["gauges"]
+
+
 def test_diagnostics_reporting(srv):
     """diagnostics.go parity, inverted default: OFF unless the operator
     configures an endpoint; the payload carries anonymized scale info."""
