@@ -219,6 +219,12 @@ class Executor:
 
     _EMPTY_PARAMS = np.zeros(0, dtype=np.int32)
 
+    # GroupBy row-id grid bounds: total combos cap the int32 count fetch
+    # (total x 4 bytes over a ~5 MB/s tunnel), prefix combos cap the
+    # dispatched grid (chunked GROUP_CHUNK per executable invocation)
+    GROUP_GRID_MAX = 1 << 20
+    GROUP_GRID_PREFIX_MAX = 16384
+
     def _batch_desc(self, index: str, c: Call):
         """(group_key, desc) for calls that can batch into one vmapped
         executable with per-call params rows; None for everything else."""
@@ -716,7 +722,14 @@ class Executor:
         # combo is counted and zero-count groups drop out, which is the
         # same answer without the per-child blocking device round trips
         # (the odometer seeds of executor.go:3058, folded into the combo
-        # dispatch).
+        # dispatch).  Only the PREFIX fields' product is dispatched (the
+        # last field rides each dispatch's per-row count vector), so the
+        # grid bounds are: prefix combos per wave (chunked to GROUP_CHUNK
+        # per executable call, all async) and the total combo count
+        # (which sizes the count fetch: total x 4 bytes).  The r4 cap of
+        # 4096 TOTAL combos fell back to blocking per-child Rows round
+        # trips for e.g. a 128x128 two-field GroupBy whose dispatch cost
+        # is actually one 128-combo wave.
         if self.mesh_exec is not None and \
                 all(set(rc.args) == {"_field"} for rc in rows_calls):
             caps = []
@@ -732,7 +745,11 @@ class Executor:
             total = 1
             for c_ in caps:
                 total *= c_
-            if 0 < total <= 4096:
+            prefix_total = 1
+            for c_ in caps[:-1]:
+                prefix_total *= c_
+            if 0 < total <= self.GROUP_GRID_MAX and \
+                    prefix_total <= self.GROUP_GRID_PREFIX_MAX:
                 fields = [(fname, list(range(c_)))
                           for fname, c_ in zip(names, caps)]
         if not fields:

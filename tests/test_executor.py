@@ -250,6 +250,49 @@ def test_group_by(ex, holder):
     ]
 
 
+def test_group_by_128x128_grid_single_wave(holder):
+    """A two-field GroupBy over 128x128 rows must take the row-id grid
+    path (one async dispatch wave) — not fall back to per-child blocking
+    Rows round trips (r4 verdict #8: the old cap was 4096 TOTAL combos;
+    only the prefix product is actually dispatched)."""
+    idx = holder.create_index("i")
+    fa = idx.create_field("a")
+    fb = idx.create_field("b")
+    rng = np.random.default_rng(9)
+    n = 20000
+    cols = rng.integers(0, 2 * SHARD_WIDTH, size=n)
+    ra = rng.integers(0, 128, size=n)
+    rb = rng.integers(0, 128, size=n)
+    fa.import_bits(ra, cols)
+    fb.import_bits(rb, cols)
+
+    e = Executor(holder, use_mesh=True)
+    # the grid path must never execute the Rows children
+    def boom(*a, **k):
+        raise AssertionError("grid path fell back to Rows execution")
+    e._execute_rows = boom
+
+    got = e.execute("i", "GroupBy(Rows(a), Rows(b))")[0]
+
+    # oracle: exact pair counts on deduplicated (row, col) bits
+    import collections
+    a_cols = collections.defaultdict(set)
+    b_cols = collections.defaultdict(set)
+    for r, c_ in zip(ra.tolist(), cols.tolist()):
+        a_cols[r].add(c_)
+    for r, c_ in zip(rb.tolist(), cols.tolist()):
+        b_cols[r].add(c_)
+    want = {}
+    for i_ in range(128):
+        for j in range(128):
+            cnt = len(a_cols[i_] & b_cols[j])
+            if cnt:
+                want[(i_, j)] = cnt
+    got_map = {(g.group[0].row_id, g.group[1].row_id): g.count
+               for g in got}
+    assert got_map == want
+
+
 def test_group_by_with_filter_and_limit(ex, holder):
     idx = holder.create_index("i")
     fa = idx.create_field("a")
