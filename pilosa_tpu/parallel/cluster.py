@@ -262,6 +262,19 @@ class InternalClient:
         })
         return result_from_wire(out["result"])
 
+    def query_calls(self, host: str, index: str, calls: list[Call],
+                    shards: list[int] | None) -> tuple[list[Any], float]:
+        """Pinned MULTI-call query: the peer executes the whole batch as
+        one device wave (its executor's grouped/prepared path) instead of
+        one dispatch per call.  Returns (results, peer_exec_seconds) so
+        the coordinator can attribute wire vs device time."""
+        out = self._json(host, "POST", f"/internal/query/{index}", {
+            "calls": [call_to_wire(c) for c in calls],
+            "shards": shards,
+        })
+        return ([result_from_wire(r) for r in out["results"]],
+                float(out.get("execS", 0.0)))
+
     def send_message(self, host: str, msg: dict,
                      timeout: float | None = None):
         """(broadcast.go SendTo -> POST /internal/cluster/message).
@@ -665,12 +678,135 @@ class Cluster:
         query = translator.translate_query(index, query)
         if shards is None:
             shards = self._available_shards(index)
-        results = [self._execute_call(index, c, shards)
-                   for c in query.calls]
+        if len(query.calls) > 1 and \
+                all(self._batchable_read(c) for c in query.calls):
+            results = self._execute_calls_batched(index, query.calls,
+                                                  shards)
+        else:
+            results = [self._execute_call(index, c, shards)
+                       for c in query.calls]
         if translator.needs_translation(index):
             results = translator.translate_results(index, query.calls,
                                                    results)
         return results
+
+    def _batchable_read(self, c: Call) -> bool:
+        """Calls whose cluster fan-out can ride one multi-call POST per
+        node (plus one shared second phase for bounded TopN).  Writes
+        must keep execution order, Options can override shards per call,
+        and TopN extras need the coordinator's global finalize — those
+        stay on the per-call path."""
+        from ..executor.executor import WRITE_CALLS
+        if c.name in WRITE_CALLS or c.name == "Options":
+            return False
+        if c.name == "TopN" and any(k in c.args for k in TOPN_EXTRAS):
+            return False
+        return True
+
+    def _execute_calls_batched(self, index: str, calls, shards):
+        """Fan a multi-call read query out as ONE pinned POST per owner
+        node — each node answers the whole batch in one device wave via
+        its executor's grouped/prepared path — plus one shared second
+        wave finishing every bounded TopN.  The r4 distributed bench paid
+        one dispatch round trip per call per phase (a 16-call batch = 32
+        sequential device RTTs per node); this is the same reduce
+        semantics (executor.go:2455 mapReduce, :879 TopN two-phase) at
+        two RTTs per batch."""
+        stats = self.api.stats
+        two_phase: set[int] = set()
+        phase1: list[Call] = []
+        for i, c in enumerate(calls):
+            if c.name == "TopN" and "n" in c.args:
+                if c.args.get("n") and "ids" not in c.args and \
+                        len(self.nodes) > 1:
+                    two_phase.add(i)
+                    phase1.append(self._topn_phase1_call(c))
+                else:
+                    # exact path: n applies at reduce, nodes must not
+                    # truncate rows whose count only wins globally
+                    p = c.clone()
+                    del p.args["n"]
+                    phase1.append(p)
+            else:
+                phase1.append(c)
+        grouped = self._fan_out_multi(index, phase1, shards)
+        results: list[Any] = [None] * len(calls)
+        phase2: list[tuple[int, Call]] = []
+        with stats.timer("cluster.multi.reduce"):
+            for i, c in enumerate(calls):
+                if i in two_phase:
+                    cands = sorted({p.id for r in grouped[i] for p in r})
+                    if not cands:
+                        results[i] = []
+                        continue
+                    phase2.append((i, self._topn_phase2_call(c, cands)))
+                else:
+                    results[i] = self._reduce(index, c, grouped[i])
+        if phase2:
+            r2 = self._fan_out_multi(index, [p for _, p in phase2],
+                                     shards)
+            with stats.timer("cluster.multi.reduce"):
+                for (i, _p2), rr in zip(phase2, r2):
+                    results[i] = self._topn_finalize(calls[i], rr)
+        return results
+
+    def _fan_out_multi(self, index: str, calls: list[Call],
+                       shards: list[int]) -> list[list[Any]]:
+        """Fan one pinned multi-call query to shard owners with replica
+        retry; returns per-call lists of group results.  Per-node wire
+        overhead (POST elapsed minus the peer's reported execution time)
+        and peer execution time feed /debug/vars for the distributed
+        latency breakdown."""
+        stats = self.api.stats
+        out: list[list[Any]] = [[] for _ in calls]
+        q = Query(list(calls))
+        if not shards:
+            for i, r in enumerate(self.api.executor.execute(
+                    index, q, [], translate=False)):
+                out[i].append(r)
+            return out
+        exclude: set[str] = set()
+        pending = list(shards)
+        for _attempt in range(len(self.nodes) + 1):
+            if not pending:
+                break
+            groups = self._group_shards(index, pending, exclude)
+            futures = {}
+            local_shards = groups.pop(self.node_id, None)
+            for nid, nshards in groups.items():
+                futures[nid] = (nshards, time.perf_counter(),
+                                self._pool.submit(
+                                    self.client.query_calls,
+                                    self.by_id[nid].host, index, calls,
+                                    nshards))
+            if local_shards is not None:
+                with stats.timer("cluster.multi.local_exec"):
+                    for i, r in enumerate(self.api.executor.execute(
+                            index, q, local_shards, translate=False)):
+                        out[i].append(r)
+            pending = []
+            for nid, (nshards, t0, fut) in futures.items():
+                try:
+                    res, exec_s = fut.result()
+                    elapsed = time.perf_counter() - t0
+                    stats.timing("cluster.multi.peer_exec", exec_s)
+                    stats.timing("cluster.multi.wire_overhead",
+                                 max(elapsed - exec_s, 0.0))
+                    for i, r in enumerate(res):
+                        out[i].append(r)
+                except Exception:
+                    self._mark_down(nid)
+                    exclude.add(nid)
+                    pending.extend(nshards)
+            if not pending:
+                break
+        else:
+            raise ClusterError("query retries exhausted")
+        if pending:
+            raise ClusterError(
+                f"no replicas available for shards {pending} of "
+                f"{index!r}")
+        return out
 
     def _execute_call(self, index: str, c: Call, shards: list[int]):
         if c.name in ("Set", "Clear"):
@@ -775,31 +911,49 @@ class Cluster:
             counts, row_tot, src, c.args.get("ids"), n, tan_thresh,
             attr_name, attr_values, field)
 
-    def _execute_topn_two_phase(self, index: str, c: Call,
-                                shards: list[int]):
-        """TopN(n=k) across nodes in two bounded phases
-        (executor.go:879-899): phase 1 fans out a per-node candidate top
-        list — each node ships O(k) pairs, not every nonzero row — and
-        phase 2 re-fetches exact global counts for the union of candidate
-        ids.  APPROXIMATE like the reference's cache-based phase 1: a row
-        can rank below every node's candidate cutoff yet sum into the
-        global top k; the 4x slack makes that require a pathologically
-        skewed distribution, and the counts reported for returned rows are
-        always exact (phase 2)."""
+    @staticmethod
+    def _topn_phase1_call(c: Call) -> Call:
+        """Phase-1 candidate call: per-node top list with 4x slack
+        (executor.go:879-899).  APPROXIMATE like the reference's
+        cache-based phase 1: a row can rank below every node's candidate
+        cutoff yet sum into the global top k; the slack makes that
+        require a pathologically skewed distribution, and the counts
+        reported for returned rows are always exact (phase 2)."""
         n, _ = c.uint_arg("n")
         phase1 = c.clone()
         phase1.args["n"] = max(4 * n, n + 16)
+        return phase1
+
+    @staticmethod
+    def _topn_phase2_call(c: Call, candidates: list[int]) -> Call:
+        """Phase-2 exact-recount call over the candidate union."""
+        phase2 = c.clone()
+        del phase2.args["n"]
+        phase2.args["ids"] = candidates
+        return phase2
+
+    @staticmethod
+    def _topn_finalize(c: Call, group_results) -> list:
+        """Merge phase-2 per-group pairs and apply the original n."""
+        n, _ = c.uint_arg("n")
+        merged = merge_pairs(group_results)
+        return sort_pairs([p for p in merged if p.count > 0], n or None)
+
+    def _execute_topn_two_phase(self, index: str, c: Call,
+                                shards: list[int]):
+        """TopN(n=k) across nodes in two bounded phases: phase 1 fans
+        out a per-node candidate top list — each node ships O(k) pairs,
+        not every nonzero row — and phase 2 re-fetches exact global
+        counts for the union of candidate ids (see _topn_phase1_call)."""
         results = []
-        for r in self._fan_out_read(index, phase1, shards):
+        for r in self._fan_out_read(index, self._topn_phase1_call(c),
+                                    shards):
             results.extend(r)
         candidates = sorted({p.id for p in results})
         if not candidates:
             return []
-        phase2 = c.clone()
-        del phase2.args["n"]
-        phase2.args["ids"] = candidates
-        merged = merge_pairs(self._fan_out_read(index, phase2, shards))
-        return sort_pairs([p for p in merged if p.count > 0], n or None)
+        return self._topn_finalize(c, self._fan_out_read(
+            index, self._topn_phase2_call(c, candidates), shards))
 
     def _execute_read(self, index: str, c: Call, shards: list[int]):
         send = c
@@ -822,45 +976,10 @@ class Cluster:
     def _fan_out_read(self, index: str, send: Call,
                       shards: list[int]) -> list[Any]:
         """Fan a pinned read call out to shard owners with replica retry;
-        returns the per-group raw results (executor.go:2455 mapReduce)."""
-        results: list[Any] = []
-        exclude: set[str] = set()
-        pending = list(shards)
-        if not pending:
-            return [self._local_exec(index, send, [])]
-        for _attempt in range(len(self.nodes) + 1):
-            if not pending and results:
-                break
-            groups = self._group_shards(index, pending, exclude)
-            futures = {}
-            # submit remote work BEFORE running the local group so the two
-            # overlap (the reference's mapperLocal + remoteExec run
-            # concurrently, executor.go:2455)
-            local_shards = groups.pop(self.node_id, None)
-            for nid, nshards in groups.items():
-                futures[nid] = (nshards, self._pool.submit(
-                    self.client.query_call, self.by_id[nid].host, index,
-                    send, nshards))
-            if local_shards is not None:
-                results.append(self._local_exec(index, send, local_shards))
-            pending = []
-            for nid, (nshards, fut) in futures.items():
-                try:
-                    results.append(fut.result())
-                except Exception:
-                    # replica retry (executor.go:2482 reduce with node
-                    # failure -> retry against remaining replicas)
-                    self._mark_down(nid)
-                    exclude.add(nid)
-                    pending.extend(nshards)
-            if not pending:
-                break
-        else:
-            raise ClusterError("query retries exhausted")
-        if pending:
-            raise ClusterError(
-                f"no replicas available for shards {pending} of {index!r}")
-        return results
+        returns the per-group raw results (executor.go:2455 mapReduce).
+        The single-call case of ``_fan_out_multi`` — one retry/owner-
+        grouping machinery, not two."""
+        return self._fan_out_multi(index, [send], shards)[0]
 
     # -- writes ------------------------------------------------------------
 
@@ -1722,8 +1841,16 @@ class Cluster:
 
         def internal_query(req, args):
             body = req.json()
-            call = call_from_wire(body["call"])
             shards = body.get("shards")
+            if "calls" in body:
+                calls = [call_from_wire(c) for c in body["calls"]]
+                t0 = time.perf_counter()
+                res = cluster.api.executor.execute(
+                    args["index"], Query(calls), shards or [],
+                    translate=False)
+                return {"results": [result_to_wire(r) for r in res],
+                        "execS": time.perf_counter() - t0}
+            call = call_from_wire(body["call"])
             result = cluster._local_exec(args["index"], call, shards or [])
             return {"result": result_to_wire(result)}
 

@@ -382,6 +382,45 @@ class _HandlerClass(BaseHTTPRequestHandler):
         pass
 
 
+class TrackingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose live connections can be severed.
+
+    ``shutdown()`` only stops the accept loop: per-connection handler
+    threads stay parked on keep-alive reads and keep serving the CLOSED
+    server's object graph.  After a same-port restart, a peer's pooled
+    internal-client connection would then write into the dead holder —
+    the write reports success and vanishes (found by the r5 cluster
+    differential fuzz as a one-bit divergence on a restarted node).
+    ``close_connections()`` severs every tracked socket so those threads
+    exit and clients reconnect to the live server."""
+
+    def server_bind(self):
+        import threading
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        super().server_bind()
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def close_connections(self):
+        import socket as _socket
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+
 def make_http_server(api: API, host: str = "localhost", port: int = 10101,
                      server=None, tls=None) -> ThreadingHTTPServer:
     """``tls``: optional (certificate, key, ca_certificate|None) paths —
@@ -390,7 +429,7 @@ def make_http_server(api: API, host: str = "localhost", port: int = 10101,
     router = build_router(api, server)
     cls = type("Handler", (_HandlerClass,), {"router": router})
     if tls is None:
-        return ThreadingHTTPServer((host, port), cls)
+        return TrackingHTTPServer((host, port), cls)
     import ssl
     cert, key, ca = tls
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -399,7 +438,7 @@ def make_http_server(api: API, host: str = "localhost", port: int = 10101,
         ctx.load_verify_locations(ca)
         ctx.verify_mode = ssl.CERT_REQUIRED  # mutual TLS
 
-    class _TLSServer(ThreadingHTTPServer):
+    class _TLSServer(TrackingHTTPServer):
         """Per-connection TLS: the handshake runs in the HANDLER thread
         (finish_request), never the accept loop — a stalled or plain-TCP
         client must not block every other connection."""

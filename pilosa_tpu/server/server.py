@@ -276,6 +276,12 @@ class Server:
         self._closing.set()
         self.diagnostics.close()
         self.httpd.shutdown()
+        # sever live keep-alive connections: their handler threads would
+        # otherwise keep serving THIS closed server's holder — and after
+        # a same-port restart, peers' pooled connections would write
+        # into the dead object graph (r5 cluster-fuzz finding)
+        if hasattr(self.httpd, "close_connections"):
+            self.httpd.close_connections()
         self.httpd.server_close()
         if self.cluster is not None:
             self.cluster.close()

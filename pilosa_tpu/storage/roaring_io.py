@@ -213,40 +213,33 @@ def _count_runs(vals: np.ndarray) -> int:
     return int(np.count_nonzero(np.diff(vals.astype(np.int64)) != 1)) + 1
 
 
-def pack_roaring(rows: np.ndarray, cols: np.ndarray) -> bytes:
-    """Serialize (row, shard-local col) bits to the pilosa-roaring format,
-    choosing the cheapest container per key with the reference's optimize
-    heuristic (roaring.go:2232): runs when run count <= RUN_MAX_SIZE and
-    <= N/2, else array when N < ARRAY_MAX_SIZE, else bitmap."""
-    rows = np.asarray(rows, dtype=np.int64)
-    cols = np.asarray(cols, dtype=np.int64)
-    pos = np.unique(rows * SHARD_WIDTH + cols)
-    keys = pos >> 16
-    low = (pos & 0xFFFF).astype("<u2")
+def _choose_container(vals: np.ndarray) -> tuple[int, int, bytes]:
+    """(type, cardinality, payload) for one container's sorted unique u16
+    values, per the optimize heuristic (roaring.go:2232): runs when run
+    count <= RUN_MAX_SIZE and <= N/2, else array when N < ARRAY_MAX_SIZE,
+    else bitmap."""
+    n = int(vals.size)
+    n_runs = _count_runs(vals)
+    if n_runs <= RUN_MAX_SIZE and n_runs <= n // 2:
+        v = vals.astype(np.int64)
+        brk = np.nonzero(np.diff(v) != 1)[0]
+        starts = np.concatenate(([v[0]], v[brk + 1]))
+        lasts = np.concatenate((v[brk], [v[-1]]))
+        payload = struct.pack("<H", n_runs) + np.column_stack(
+            (starts, lasts)).astype("<u2").tobytes()
+        return TYPE_RUN, n, payload
+    if n < ARRAY_MAX_SIZE:
+        return TYPE_ARRAY, n, vals.astype("<u2").tobytes()
+    words = np.zeros(1024, dtype="<u8")
+    v = vals.astype(np.int64)
+    np.bitwise_or.at(words, v >> 6,
+                     np.uint64(1) << (v & 63).astype(np.uint64))
+    return TYPE_BITMAP, n, words.tobytes()
 
-    # (key, type, cardinality, payload)
-    containers: list[tuple[int, int, int, bytes]] = []
-    for key in np.unique(keys):
-        vals = low[keys == key]
-        n = int(vals.size)
-        n_runs = _count_runs(vals)
-        if n_runs <= RUN_MAX_SIZE and n_runs <= n // 2:
-            v = vals.astype(np.int64)
-            brk = np.nonzero(np.diff(v) != 1)[0]
-            starts = np.concatenate(([v[0]], v[brk + 1]))
-            lasts = np.concatenate((v[brk], [v[-1]]))
-            payload = struct.pack("<H", n_runs) + np.column_stack(
-                (starts, lasts)).astype("<u2").tobytes()
-            containers.append((int(key), TYPE_RUN, n, payload))
-        elif n < ARRAY_MAX_SIZE:
-            containers.append((int(key), TYPE_ARRAY, n, vals.tobytes()))
-        else:
-            words = np.zeros(1024, dtype="<u8")
-            v = vals.astype(np.int64)
-            np.bitwise_or.at(words, v >> 6,
-                             np.uint64(1) << (v & 63).astype(np.uint64))
-            containers.append((int(key), TYPE_BITMAP, n, words.tobytes()))
 
+def _assemble(containers: list[tuple[int, int, int, bytes]]) -> bytes:
+    """Assemble (key, type, cardinality, payload) containers into a
+    pilosa-roaring blob (roaring.go:1046 WriteTo layout)."""
     out = bytearray()
     out += struct.pack("<I", MAGIC)
     out += struct.pack("<I", len(containers))
@@ -259,3 +252,53 @@ def pack_roaring(rows: np.ndarray, cols: np.ndarray) -> bytes:
     for _, _, _, payload in containers:
         out += payload
     return bytes(out)
+
+
+def pack_roaring(rows: np.ndarray, cols: np.ndarray) -> bytes:
+    """Serialize (row, shard-local col) bits to the pilosa-roaring format,
+    choosing the cheapest container per key with the reference's optimize
+    heuristic (see _choose_container)."""
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    pos = np.unique(rows * SHARD_WIDTH + cols)
+    keys = pos >> 16
+    low = (pos & 0xFFFF).astype("<u2")
+    containers = []
+    for key in np.unique(keys):
+        ctype, n, payload = _choose_container(low[keys == key])
+        containers.append((int(key), ctype, n, payload))
+    return _assemble(containers)
+
+
+def pack_roaring_words(words: np.ndarray) -> bytes:
+    """Serialize a dense [rows, SHARD_WORDS] uint32 words block without
+    expanding to bit pairs (bulk loaders / bench fixtures).  Dense
+    windows (the bitmap-container regime) are memcpy'd straight from the
+    word block — a 65536-column window's bitmap payload IS its 8KB word
+    slice; sparse/runny windows go through the same per-container
+    chooser as pack_roaring."""
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    n_rows = words.shape[0]
+    per_row = SHARD_WIDTH >> 16  # 65536-col windows per row
+    blocks = words.reshape(n_rows * per_row, 2048)
+    cards = np.bitwise_count(blocks).sum(axis=1)
+    containers = []
+    for bi in np.nonzero(cards)[0]:
+        key = int(bi)  # key = row * per_row + window, in row-major order
+        card = int(cards[bi])
+        if card >= ARRAY_MAX_SIZE:
+            # candidate bitmap: verify runs don't win without unpacking
+            w = blocks[bi].view("<u8")
+            shifted = (w << np.uint64(1))
+            shifted[1:] |= (w[:-1] >> np.uint64(63))
+            n_runs = int(np.bitwise_count(w & ~shifted).sum())
+            if not (n_runs <= RUN_MAX_SIZE and n_runs <= card // 2):
+                containers.append(
+                    (key, TYPE_BITMAP, card, blocks[bi].tobytes()))
+                continue
+        bits = np.unpackbits(blocks[bi].view(np.uint8),
+                             bitorder="little")
+        vals = np.nonzero(bits)[0].astype("<u2")
+        ctype, n, payload = _choose_container(vals)
+        containers.append((key, ctype, n, payload))
+    return _assemble(containers)

@@ -142,6 +142,54 @@ def test_import_and_distributed_queries(cluster3):
     assert rws["rows"] == sorted(by_row)
 
 
+def test_batched_multicall_matches_per_call(cluster3):
+    """A multi-call read query rides ONE pinned multi-call POST per node
+    (cluster._execute_calls_batched) — answers must be identical to the
+    per-call fan-out, including bounded-TopN two-phase results."""
+    setup_index(cluster3)
+    rng = np.random.default_rng(13)
+    n_shards = 6
+    cols = rng.choice(n_shards * SHARD_WIDTH, size=4000, replace=False)
+    rows = rng.integers(0, 8, size=4000)
+    vals = rng.integers(0, 1000, size=2000)
+    p0 = cluster3[0].port
+    _req(p0, "POST", "/index/ci/field/f/import",
+         {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()})
+    _req(p0, "POST", "/index/ci/field/v/import",
+         {"columnIDs": cols[:2000].tolist(), "values": vals.tolist()})
+
+    multi = ("Count(Row(f=1)) TopN(f, n=3) Sum(Row(f=2), field=v) "
+             "Count(Intersect(Row(f=3), Row(f=4))) Rows(f) Row(f=5)")
+    batched = query(p0, "ci", multi)
+    # per-call ground truth through the same cluster
+    singles = []
+    for q in ("Count(Row(f=1))", "TopN(f, n=3)",
+              "Sum(Row(f=2), field=v)",
+              "Count(Intersect(Row(f=3), Row(f=4)))", "Rows(f)",
+              "Row(f=5)"):
+        singles.append(query(cluster3[1].port, "ci", q)[0])
+    assert batched == singles
+    # every node agrees (coordinator or not)
+    assert query(cluster3[2].port, "ci", multi) == singles
+    # the breakdown instrumentation populated
+    snap = _req(p0, "GET", "/debug/vars")
+    assert "cluster.multi.peer_exec" in snap["timings"]
+    assert "cluster.multi.reduce" in snap["timings"]
+
+
+def test_batched_multicall_replica_retry(cluster3):
+    """Batched fan-out keeps the per-call path's replica retry: killing a
+    node mid-cluster must not change multi-call answers."""
+    setup_index(cluster3)
+    p0 = cluster3[0].port
+    query(p0, "ci", "Set(5, f=1) Set(300000, f=1) Set(2097200, f=2)")
+    want = query(p0, "ci", "Count(Row(f=1)) Count(Row(f=2)) TopN(f, n=2)")
+    cluster3[2].close()
+    cluster3[0].cluster.probe_peers()
+    got = query(p0, "ci", "Count(Row(f=1)) Count(Row(f=2)) TopN(f, n=2)")
+    assert got == want
+
+
 def test_replica_write_fanout(cluster3):
     setup_index(cluster3)
     # write through a NON-owner node: must reach all replicas of the shard
