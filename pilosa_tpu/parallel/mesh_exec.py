@@ -58,6 +58,14 @@ class MeshExecutor:
     def __init__(self, mesh: Mesh | None = None):
         self.mesh = mesh or default_mesh()
         self.n_devices = self.mesh.devices.size
+        # A mesh spanning >1 jax process (multihost mode 2,
+        # parallel/multihost.py): shard-axis-sharded OUTPUTS are not
+        # addressable from any single process, so executables that
+        # return per-shard results gather them over the shard axis
+        # (all_gather rides ICI/DCN) and replicate — aggregations
+        # (psum) are replicated already.
+        self.multiprocess = len(
+            {d.process_index for d in self.mesh.devices.flat}) > 1
         # Fragment mirrors must live on the mesh's platform (e.g. a virtual
         # CPU mesh while the default backend is a TPU).  When the mesh IS on
         # the default platform we stage with target=None so the mesh path
@@ -100,12 +108,17 @@ class MeshExecutor:
 
     # -- compiled executables ---------------------------------------------
 
-    def _jit_shard_map(self, key, block_fn, in_specs, out_specs):
+    def _jit_shard_map(self, key, block_fn, in_specs, out_specs,
+                       check_vma: bool = True):
+        """``check_vma=False`` for multiprocess gather executables: their
+        P() outputs ARE replicated (all_gather over the shard axis), but
+        shard_map's static varying-axes checker cannot infer that."""
         fn = self._cache.get(key)
         if fn is None:
             fn = jax.jit(jax.shard_map(
                 block_fn, mesh=self.mesh,
-                in_specs=in_specs, out_specs=out_specs))
+                in_specs=in_specs, out_specs=out_specs,
+                check_vma=check_vma))
             self._cache[key] = fn
         return fn
 
@@ -141,6 +154,14 @@ class MeshExecutor:
                 return jax.lax.psum(local, axis_name=SHARD_AXIS)
 
             out_specs = P()
+        elif self.multiprocess:
+            def block_fn(params, *arrays):
+                segs = vmapped(params, *arrays)    # [S_local, W]
+                return jax.lax.all_gather(segs, SHARD_AXIS, tiled=True)
+
+            in_specs = (P(),) + tuple(P(SHARD_AXIS) for _ in shapes)
+            return self._jit_shard_map(key, block_fn, in_specs, P(),
+                                       check_vma=False)
         else:
             def block_fn(params, *arrays):
                 return vmapped(params, *arrays)    # [S_local, W]
@@ -207,7 +228,12 @@ class MeshExecutor:
                     1 for fr in frs
                     if not fr._device_dirty
                     and fr._mirrors.get(self.stage_device) is not None)
-                if 5 * resident >= 4 * len(frs):
+                if self.multiprocess:
+                    # per-process staging: each process supplies only its
+                    # addressable shards (device_put would assert the
+                    # whole host block equal across processes)
+                    p = self._place_host_block(frs, shape)
+                elif 5 * resident >= 4 * len(frs):
                     arrs = [fr.device(self.stage_device) for fr in frs]
                     if all(a.shape == shape for a in arrs):
                         p = self._pad_and_place(arrs, shape, len(frs))
@@ -293,15 +319,34 @@ class MeshExecutor:
     def _place_host_block(self, frs, shape):
         """Cold-path staging: densify the group's fragments into one host
         block and place it mesh-sharded in a single transfer (bypassing
-        per-fragment mirrors entirely)."""
+        per-fragment mirrors entirely).  On a multi-process mesh each
+        process materializes ONLY the shard rows jax asks it for (its
+        addressable devices) — the per-host import pipeline fills just
+        the local slice (multihost.import_process_slice), and remote
+        shards' placeholder fragments densify to zeros that are never
+        consulted."""
         n = len(frs)
-        block = np.zeros((self._bucket(n),) + shape, dtype=np.uint32)
-        for i, fr in enumerate(frs):
-            dense = fr.to_dense()
-            r = min(dense.shape[0], shape[0])  # cap may race a grow
-            block[i, :r] = dense[:r]
         sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
-        return jax.device_put(block, sharding)
+        bucket = self._bucket(n)
+
+        def fill(block, lo):
+            for i in range(lo, min(lo + block.shape[0], n)):
+                dense = frs[i].to_dense()
+                r = min(dense.shape[0], shape[0])  # cap may race a grow
+                block[i - lo, :r] = dense[:r]
+            return block
+
+        if self.multiprocess:
+            def cb(index):
+                s = index[0]
+                lo = s.start or 0
+                hi = s.stop if s.stop is not None else bucket
+                return fill(np.zeros((hi - lo,) + shape, np.uint32), lo)
+
+            return jax.make_array_from_callback(
+                (bucket,) + shape, sharding, cb)
+        return jax.device_put(
+            fill(np.zeros((bucket,) + shape, np.uint32), 0), sharding)
 
     @staticmethod
     def _present(keys, placed, sig):
@@ -516,15 +561,33 @@ class MeshExecutor:
                         filt = eval_plan(fplan, frags, params_)
                     return bsi.min_max_bits(frag, filt, want_max=want_max)
 
-                def block_fn(params_, *arrays):
-                    return jax.vmap(
-                        per_shard, in_axes=(None,) + (0,) * len(pshapes))(
-                            params_, *arrays)
+                if self.multiprocess:
+                    def block_fn(params_, *arrays):
+                        outs = jax.vmap(
+                            per_shard,
+                            in_axes=(None,) + (0,) * len(pshapes))(
+                                params_, *arrays)
+                        return tuple(
+                            jax.lax.all_gather(o, SHARD_AXIS, tiled=True)
+                            for o in outs)
+
+                    out_specs = (P(), P(), P())
+                    check_vma = False
+                else:
+                    def block_fn(params_, *arrays):
+                        return jax.vmap(
+                            per_shard,
+                            in_axes=(None,) + (0,) * len(pshapes))(
+                                params_, *arrays)
+
+                    out_specs = (P(SHARD_AXIS), P(SHARD_AXIS),
+                                 P(SHARD_AXIS))
+                    check_vma = True
 
                 fn = self._jit_shard_map(
                     key, block_fn,
                     (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes),
-                    (P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)))
+                    out_specs, check_vma=check_vma)
             bits, neg, cnt = (np.asarray(x) for x in fn(params, *placed_args))
             for i in range(len(shard_list)):
                 out.append(bsi.reconstruct_min_max(

@@ -62,10 +62,40 @@ def global_mesh():
 
 def process_shard_slice(n_shards: int) -> tuple[int, int]:
     """The contiguous shard range this process would own under an even
-    split — a helper for feeding per-host import pipelines."""
+    split — the per-host partition for ``import_process_slice``."""
     import jax
 
     n = jax.process_count()
     i = jax.process_index()
     per = (n_shards + n - 1) // n
     return min(i * per, n_shards), min((i + 1) * per, n_shards)
+
+
+def import_process_slice(field, rows, cols, n_shards: int,
+                         max_row_id: int) -> tuple[int, int]:
+    """Per-host import pipeline for multihost mode 2: this process keeps
+    only ITS shard slice's bits host-side (the rest of the global array
+    is supplied by the other processes' addressable device shards at
+    staging time), while every process creates shape-matched empty
+    fragments for remote shards so the stacked mesh groups — and thus
+    the compiled SPMD executables — are identical on all processes.
+
+    ``max_row_id``: the GLOBAL maximum row id across all hosts (row
+    capacity grows in powers of two and is part of the executable's
+    shape signature, so it must agree everywhere).  Returns the local
+    (lo, hi) shard range."""
+    import numpy as np
+
+    from ..core import SHARD_WIDTH, VIEW_STANDARD
+
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    lo, hi = process_shard_slice(n_shards)
+    sel = (cols >= lo * SHARD_WIDTH) & (cols < hi * SHARD_WIDTH)
+    field.import_bits(rows[sel], cols[sel])
+    view = field._create_view_if_not_exists(VIEW_STANDARD)
+    for s in range(n_shards):
+        fr = view.create_fragment_if_not_exists(s)
+        if fr.n_rows <= max_row_id:
+            fr.set_row(max_row_id, None)  # grow capacity, no bits
+    return lo, hi
