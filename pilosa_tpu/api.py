@@ -29,7 +29,13 @@ _DEGRADED_OK = {
     "Query", "Schema", "Status", "Version", "Info", "GetIndex", "GetIndexes",
     "ExportCSV", "ShardNodes", "Hosts",
 }
-_RESIZING_OK = {"Status", "Version", "Info", "Hosts", "ClusterMessage"}
+# Queries keep serving during a resize like the reference (reads route by
+# the pre-resize placement; old owners retain their fragments until the
+# deferred holder cleaner runs after the membership switch).  WRITE calls
+# inside a query are rejected by the cluster layer while RESIZING — data
+# in flight between owners cannot accept mutations exactly-once.
+_RESIZING_OK = {"Query", "Schema", "Status", "Version", "Info", "GetIndex",
+                "GetIndexes", "ShardNodes", "Hosts", "ClusterMessage"}
 
 
 class ApiError(Exception):

@@ -516,6 +516,10 @@ class Cluster:
         # .topology, carried on resize-complete messages so retries are
         # idempotent and stale nodes are detectable by probe
         self.epoch = 0
+        # seconds before post-resize fragment GC (0 = inline); covers the
+        # window where nodes adopt the new membership at different times
+        # while reads keep serving
+        self.cleaner_grace = 5.0
         self._load_topology()
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * len(self.nodes)))
@@ -671,6 +675,17 @@ class Cluster:
         if self.holder.index(index) is None:
             from ..api import NotFoundError
             raise NotFoundError(f"index not found: {index}")
+        # Reject writes while RESIZING BEFORE translation: a create-on-
+        # miss key lookup for a rejected write must not durably mutate
+        # the replicated translate stores mid-resize.
+        if self.state == STATE_RESIZING:
+            writes = sorted({name for c in query.calls
+                             for name in self._write_names(c)})
+            if writes:
+                from ..api import DisallowedError
+                raise DisallowedError(
+                    f"write calls {writes} are blocked while the cluster "
+                    f"is resizing (reads keep serving)")
         # key translation happens ONCE at the coordinating node; fanned-out
         # internal calls carry ids only (executor.go:147 skips
         # translateCalls when opt.Remote)
@@ -689,6 +704,18 @@ class Cluster:
             results = translator.translate_results(index, query.calls,
                                                    results)
         return results
+
+    @classmethod
+    def _write_names(cls, c: Call):
+        """Write-call names inside ``c``, looking through Options
+        wrappers (Options(Set(...)) must not slip past the resize write
+        block)."""
+        from ..executor.executor import WRITE_CALLS
+        if c.name in WRITE_CALLS:
+            yield c.name
+        elif c.name == "Options":
+            for ch in c.children:
+                yield from cls._write_names(ch)
 
     def _batchable_read(self, c: Call) -> bool:
         """Calls whose cluster fan-out can ride one multi-call POST per
@@ -1277,9 +1304,13 @@ class Cluster:
         bits are CLEARED (no resurrection), and peers whose value disagrees
         with consensus get repairs PUSHED to them (fragment.go:1875
         mergeBlock + :2941 syncFragment).  Attr stores sync by block diff
-        (holder.go:1002-1096)."""
+        (holder.go:1002-1096).  Also re-runs the holder cleaner: post-
+        resize fragment GC is deferred (see _apply_resize_complete), and
+        the AE cadence is its periodic backstop (holder.go:1131)."""
         from ..storage.roaring_io import unpack_roaring
 
+        if self.state != STATE_RESIZING:
+            self._holder_cleaner()
         holder = self.holder
         for index_name, idx in list(holder.indexes.items()):
             shards = self._available_shards(index_name)
@@ -1813,11 +1844,32 @@ class Cluster:
         self.placement = Placement([n.id for n in self.nodes],
                                    replica_n=self.replica_n,
                                    hasher=self.placement.hasher)
-        self._holder_cleaner()
         self.epoch = msg_epoch
         self._save_topology()
         self.state = STATE_NORMAL
         self._update_state()
+        # Fragment GC is DEFERRED (cluster.go holderCleaner runs on a
+        # schedule, not inline): queries keep serving during the resize,
+        # and nodes adopt the new membership at slightly different
+        # moments — a read routed by the old placement in that window
+        # must still find data on the old owner.  The grace covers the
+        # adoption skew; the anti-entropy loop also re-runs the cleaner.
+        if self.cleaner_grace <= 0:
+            self._holder_cleaner()
+        else:
+            t = threading.Timer(self.cleaner_grace, self._cleaner_tick)
+            t.daemon = True
+            t.start()
+
+    def _cleaner_tick(self):
+        # same guard as the AE backstop: a stale grace timer must not GC
+        # fragments a SUBSEQUENT resize just fetched (they are unowned
+        # under the still-current placement until that resize completes)
+        if not self._closing.is_set() and self.state != STATE_RESIZING:
+            try:
+                self._holder_cleaner()
+            except Exception:
+                pass
 
     def _holder_cleaner(self):
         """Drop fragments this node no longer owns under the current

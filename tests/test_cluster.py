@@ -506,7 +506,11 @@ def test_resize_grow_and_shrink(tmp_path):
                 assert cnt == oracle[r], (srv.cluster.node_id, r)
         # the new node actually owns data (placement rebalanced onto it)
         assert _owned_frag_count(servers[2]) > 0
-        # and owners hold exactly their placement's fragments (cleaner ran)
+        # and owners hold exactly their placement's fragments once the
+        # (deferred) cleaner runs — reads during the adoption window rely
+        # on old owners retaining data, so GC is not inline
+        for srv in servers:
+            srv.cluster._holder_cleaner()
         pl = servers[0].cluster.placement
         for srv in servers:
             nid = srv.cluster.node_id
@@ -529,6 +533,38 @@ def test_resize_grow_and_shrink(tmp_path):
                 s.close()
             except Exception:
                 pass
+
+
+def test_reads_serve_writes_blocked_during_resize(cluster3):
+    """The reference keeps serving queries during a resize; here reads
+    keep answering (old placement + deferred GC keep them exact) while
+    write calls and DDL are rejected until the resize completes."""
+    setup_index(cluster3)
+    query(cluster3[0].port, "ci", "Set(5, f=1) Set(2097200, f=2)")
+    for srv in cluster3:
+        srv.cluster.state = "RESIZING"
+    try:
+        for srv in cluster3:
+            [cnt] = query(srv.port, "ci", "Count(Row(f=1))")
+            assert cnt == 1
+            got = query(srv.port, "ci",
+                        "Count(Row(f=1)) Count(Row(f=2)) TopN(f, n=1)")
+            assert got[0] == 1 and got[1] == 1
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            query(cluster3[0].port, "ci", "Set(6, f=1)")
+        assert exc.value.code == 400
+        # Options wrapping must not smuggle a write past the block
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            query(cluster3[0].port, "ci", "Options(Set(6, f=1))")
+        assert exc.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _req(cluster3[0].port, "POST", "/index/ci/field/h", {})
+        assert exc.value.code == 400
+    finally:
+        for srv in cluster3:
+            srv.cluster.state = "NORMAL"
+    [cnt] = query(cluster3[0].port, "ci", "Count(Row(f=1))")
+    assert cnt == 1
 
 
 def test_resize_abort_restores_service(cluster3):
