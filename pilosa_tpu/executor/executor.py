@@ -88,6 +88,97 @@ class _PendingGroup:
                                if hp else [0] * nB))
 
 
+# A batched executable materializes roughly one [B, SHARD_WORDS] u32
+# gather temp per params slot per stacked shard (measured: an 8-slot
+# Intersect batch at B=16384 on one shard exhausts a 16 GB HBM with
+# 8 x 2 GB gather temps).  Batches are therefore dispatched in chunks
+# sized so those temps stay under BATCH_TEMP_BYTES, and every chunk is
+# padded up to a power of two (repeating its last row — always in-range)
+# so arbitrary client batch sizes reuse a bounded set of compiled
+# executables instead of compiling one per distinct B (~20-40 s each
+# through an accelerator tunnel).
+BATCH_TEMP_BYTES = 4 << 30
+BATCH_CHUNK_MIN, BATCH_CHUNK_MAX = 8, 32768
+
+
+def _batch_chunks(params_mat: np.ndarray, n_shards: int):
+    """Yield (lo, n, padded_params) covering params_mat[lo:lo+n]; padded
+    rows beyond n are duplicates whose results the caller ignores.
+    ``n_shards`` is the per-device stacked-shard count — gather temps
+    live per device, so the budget divides by the mesh size, not the
+    total shard count."""
+    from ..core import SHARD_WORDS
+
+    B, P = params_mat.shape
+    weight = max(1, P) * max(1, n_shards) * SHARD_WORDS * 4
+    chunk = max(BATCH_CHUNK_MIN,
+                min(BATCH_CHUNK_MAX, BATCH_TEMP_BYTES // weight))
+    chunk = 1 << (chunk.bit_length() - 1)
+    for lo in range(0, B, chunk):
+        sub = params_mat[lo: lo + chunk]
+        n = sub.shape[0]
+        pad = 1 << max(0, n - 1).bit_length()
+        if pad != n:
+            sub = np.concatenate([sub, np.repeat(sub[-1:], pad - n,
+                                                 axis=0)])
+        yield lo, n, sub
+
+
+def _run_batched_groups(mesh, holder, index, shards, groups, results):
+    """Dispatch batched call groups chunk-wise and fill ``results``.
+
+    ``groups``: iterable of (kind, slotted, params_mat, call_idxs, extra);
+    extra carries kind-specific fields — sum: field/view/base, topn:
+    field/view/ids_n with one (ids, n) pair per call.  Shared by the
+    classic grouped path and the prepared-statement cache so the chunking
+    policy lives in exactly one place."""
+    per_dev = mesh.stacked_per_device(len(shards))
+    for kind, slotted, params_mat, call_idxs, extra in groups:
+        if kind == "count":
+            for lo, n_c, sub in _batch_chunks(params_mat, per_dev):
+                parts = mesh.count_batch_async(slotted, sub, holder,
+                                               index, shards)
+                grp = _PendingGroup.counts(parts, call_idxs[lo: lo + n_c])
+                for i in call_idxs[lo: lo + n_c]:
+                    results[i] = grp
+        elif kind == "sum":
+            base = extra["base"]
+
+            def _sum_fin(hp, b, base=base):
+                total, cnt = 0, 0
+                for p in hp:
+                    s, c_ = bsi.weighted_sum(p[b])
+                    total += s
+                    cnt += c_
+                return ValCount(total + cnt * base, cnt)
+
+            # fin=_sum_fin binds THIS group's finalizer: a free-variable
+            # reference would late-bind to the last group's base when one
+            # invocation carries several sum groups (the prepared path)
+            for lo, n_c, sub in _batch_chunks(params_mat, per_dev):
+                parts = mesh.bsi_sum_batch_async(
+                    extra["field"], extra["view"], slotted, sub, holder,
+                    index, shards)
+                for b in range(n_c):
+                    results[call_idxs[lo + b]] = _Pending(
+                        parts, lambda hp, b=b, fin=_sum_fin: fin(hp, b))
+        else:  # topn
+            def _topn_fin(hp, b, ids, n):
+                counts = mesh.merge_counts([p[b] for p in hp])
+                return rank_counts(counts, n or None, ids)
+
+            ids_n = extra["ids_n"]
+            for lo, n_c, sub in _batch_chunks(params_mat, per_dev):
+                parts = mesh.row_counts_batch_async(
+                    extra["field"], extra["view"], slotted, sub, holder,
+                    index, shards)
+                for b in range(n_c):
+                    ids, n = ids_n[lo + b]
+                    results[call_idxs[lo + b]] = _Pending(
+                        parts, lambda hp, b=b, ids=ids, n=n,
+                        fin=_topn_fin: fin(hp, b, ids, n))
+
+
 class _Pending:
     """A dispatched-but-unresolved call result.
 
@@ -279,45 +370,18 @@ class Executor:
             ds = [descs[i] for i in idxs]
             kind = ds[0]["kind"]
             params_mat = np.stack([d["params"] for d in ds])
-            if kind == "count":
-                parts = self.mesh_exec.count_batch_async(
-                    ds[0]["slotted"], params_mat, self.holder, index, shards)
-                grp = _PendingGroup.counts(parts, idxs)
-                for i in idxs:
-                    results[i] = grp
-            elif kind == "sum":
-                parts = self.mesh_exec.bsi_sum_batch_async(
-                    ds[0]["field"], ds[0]["view"], ds[0]["slotted"],
-                    params_mat, self.holder, index, shards)
-                base = ds[0]["base"]
-
-                def _sum_fin(hp, b, base=base):
-                    total, cnt = 0, 0
-                    for p in hp:
-                        s, c_ = bsi.weighted_sum(p[b])
-                        total += s
-                        cnt += c_
-                    return ValCount(total + cnt * base, cnt)
-
-                for b, i in enumerate(idxs):
-                    results[i] = _Pending(
-                        parts, lambda hp, b=b: _sum_fin(hp, b))
-            else:  # topn
-                parts = self.mesh_exec.row_counts_batch_async(
-                    ds[0]["field"], VIEW_STANDARD, ds[0]["slotted"],
-                    params_mat, self.holder, index, shards)
-
-                def _topn_fin(hp, b, ids, n):
-                    counts = self.mesh_exec.merge_counts(
-                        [p[b] for p in hp])
-                    return rank_counts(counts, n or None, ids)
-
-                for b, i in enumerate(idxs):
-                    d = descs[i]
-                    results[i] = _Pending(
-                        parts,
-                        lambda hp, b=b, ids=d["ids"], n=d["n"]:
-                        _topn_fin(hp, b, ids, n))
+            if kind == "sum":
+                extra = {"field": ds[0]["field"], "view": ds[0]["view"],
+                         "base": ds[0]["base"]}
+            elif kind == "topn":
+                extra = {"field": ds[0]["field"], "view": VIEW_STANDARD,
+                         "ids_n": [(d["ids"], d["n"]) for d in ds]}
+            else:
+                extra = None
+            _run_batched_groups(
+                self.mesh_exec, self.holder, index, shards,
+                [(kind, ds[0]["slotted"], params_mat, idxs, extra)],
+                results)
             batched.update(idxs)
 
         for i, c in enumerate(calls):

@@ -46,11 +46,9 @@ import numpy as np
 
 from ..core import schema_epoch
 from ..native import fingerprint_native
-from ..ops import bsi
 from ..pql import parse
 from ..pql.ast import LitInt, Query
 from .plan import Resolver, parametrize
-from .results import ValCount, rank_counts
 
 # Integer literals only: quoted strings and bare timestamps pass through
 # unchanged (they stay part of the template).  The lookaround classes keep
@@ -195,53 +193,18 @@ class PreparedEntry:
     def run(self, ex, index: str, values: np.ndarray, shards):
         """Dispatch all groups, then resolve with one device fetch.
         Returns the results list, in call order."""
-        from .executor import _Pending, _PendingGroup, _resolve_pendings
+        from .executor import _resolve_pendings, _run_batched_groups
 
         holder = ex.holder
         if shards is None:
             idx = holder.index(index)
             shards = sorted(idx.available_shards())
-        mesh = ex.mesh_exec
         results: list = [None] * self.n_calls
-        for g in self.groups:
-            params = g.build_params(values)
-            if g.kind == "count":
-                parts = mesh.count_batch_async(g.slotted, params, holder,
-                                               index, shards)
-                grp = _PendingGroup.counts(parts, g.call_idxs)
-                for i in g.call_idxs:
-                    results[i] = grp
-            elif g.kind == "sum":
-                parts = mesh.bsi_sum_batch_async(
-                    g.extra["field"], g.extra["view"], g.slotted, params,
-                    holder, index, shards)
-                base = g.extra["base"]
-
-                def _sum_fin(hp, b, base=base):
-                    total, cnt = 0, 0
-                    for p in hp:
-                        s, c_ = bsi.weighted_sum(p[b])
-                        total += s
-                        cnt += c_
-                    return ValCount(total + cnt * base, cnt)
-
-                for b, i in enumerate(g.call_idxs):
-                    results[i] = _Pending(
-                        parts, lambda hp, b=b: _sum_fin(hp, b))
-            else:  # topn
-                parts = mesh.row_counts_batch_async(
-                    g.extra["field"], g.extra["view"], g.slotted, params,
-                    holder, index, shards)
-
-                def _topn_fin(hp, b, ids, n):
-                    counts = mesh.merge_counts([p[b] for p in hp])
-                    return rank_counts(counts, n or None, ids)
-
-                for b, i in enumerate(g.call_idxs):
-                    results[i] = _Pending(
-                        parts,
-                        lambda hp, b=b, ids=g.extra["ids"], n=g.extra["n"]:
-                        _topn_fin(hp, b, ids, n))
+        _run_batched_groups(
+            ex.mesh_exec, holder, index, shards,
+            ((g.kind, g.slotted, g.build_params(values), g.call_idxs,
+              g.extra) for g in self.groups),
+            results)
         return _resolve_pendings(results)
 
 
@@ -357,9 +320,17 @@ class PreparedCache:
         built = []
         for key, idxs in groups.items():
             ds = [descs[i] for i in idxs]
+            extra = ds[0]["extra"]
+            if ds[0]["kind"] == "topn":
+                # the group key omits n/ids, so calls in one group may
+                # carry different ones — keep them per call, matching the
+                # classic grouped path
+                extra = {"field": extra["field"], "view": extra["view"],
+                         "ids_n": [(d["extra"]["ids"], d["extra"]["n"])
+                                   for d in ds]}
             built.append(_Group(ds[0]["kind"], ds[0]["slotted"], idxs,
                                 [d["params"] for d in ds],
-                                [d["prov"] for d in ds], ds[0]["extra"]))
+                                [d["prov"] for d in ds], extra))
         return PreparedEntry(epoch, len(q.calls), built, guards)
 
     def _desc(self, index: str, c, guards: list):

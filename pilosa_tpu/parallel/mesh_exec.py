@@ -303,6 +303,12 @@ class MeshExecutor:
             b *= 2
         return b
 
+    def stacked_per_device(self, n_shards: int) -> int:
+        """Per-device rows of a stacked dispatch after _bucket padding —
+        the multiplier batched-dispatch chunk sizing must use (padded
+        zero shards still materialize gather temps)."""
+        return self._bucket(max(1, n_shards)) // self.n_devices
+
     def _pad_and_place(self, arrays_list, shape, n: int):
         """Stack n member arrays, pad the shard axis to its bucket, and
         place sharded over the mesh axis."""
