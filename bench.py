@@ -211,7 +211,9 @@ def _frag_bytes(executor, index, field, view="standard", rows=None):
 
 def _run_batches(executor, index, batches, n_threads, shards_of=None):
     """Execute pre-built batch strings from ``n_threads`` concurrent client
-    threads (round-robin).  Returns (qps, mean_batch_latency_s)."""
+    threads (round-robin).  Returns (qps, mean_batch_latency_s,
+    p50_batch_latency_s) — BASELINE.json's metric of record is qps + p50
+    latency, so the median rides along with the mean."""
     lat = []
 
     def run_one(i):
@@ -226,7 +228,8 @@ def _run_batches(executor, index, batches, n_threads, shards_of=None):
     with ThreadPoolExecutor(n_threads) as pool:
         counts = list(pool.map(run_one, range(len(batches))))
     dt = time.perf_counter() - t0
-    return sum(counts) / dt, sum(lat) / len(lat)
+    return (sum(counts) / dt, sum(lat) / len(lat),
+            float(np.median(lat)))
 
 
 def bench_config1(executor, meta, rng):
@@ -246,10 +249,10 @@ def bench_config1(executor, meta, rng):
         batches = [batch() for _ in range(n_batches)]
         return _run_batches(executor, "startrace", batches, T)
 
-    (qps, bat_s), spread = best_of(run)
+    (qps, bat_s, p50_s), spread = best_of(run)
     # one row segment read per query
     bytes_per_q = _frag_bytes(executor, "startrace", "stargazer", rows=1)
-    return qps, bat_s, bytes_per_q, spread
+    return qps, bat_s, p50_s, bytes_per_q, spread
 
 
 def bench_config2(executor, meta, rng):
@@ -269,10 +272,10 @@ def bench_config2(executor, meta, rng):
         batches = [batch() for _ in range(n_batches)]
         return _run_batches(executor, "startrace", batches, T)
 
-    (qps, bat_s), spread = best_of(run)
+    (qps, bat_s, p50_s), spread = best_of(run)
     # 8 row segments streamed per query
     bytes_per_q = _frag_bytes(executor, "startrace", "stargazer", rows=8)
-    return qps, bat_s, bytes_per_q, spread
+    return qps, bat_s, p50_s, bytes_per_q, spread
 
 
 def bench_config3(executor, meta, rng):
@@ -288,11 +291,11 @@ def bench_config3(executor, meta, rng):
         batches = [batch() for _ in range(n_batches)]
         return _run_batches(executor, "lang10m", batches, T)
 
-    (qps, bat_s), spread = best_of(run)
+    (qps, bat_s, p50_s), spread = best_of(run)
     # per query: full language fragment pass + one stars row per shard
     bytes_per_q = _frag_bytes(executor, "lang10m", "language") + \
         _frag_bytes(executor, "lang10m", "stars", rows=1)
-    return qps, bat_s, bytes_per_q, spread
+    return qps, bat_s, p50_s, bytes_per_q, spread
 
 
 def bench_config4(executor, meta, rng):
@@ -308,7 +311,7 @@ def bench_config4(executor, meta, rng):
         batches = [batch() for _ in range(n_batches)]
         return _run_batches(executor, "bsi64", batches, T)
 
-    (qps, bat_s), spread = best_of(run)
+    (qps, bat_s, p50_s), spread = best_of(run)
     # per query: ONE fused pass over the BSI fragment (XLA fuses the range
     # scan and the masked slice popcounts into a single read of the
     # stacked block)
@@ -329,7 +332,7 @@ def bench_config4(executor, meta, rng):
     t0 = time.perf_counter()
     executor.execute("grid4", "GroupBy(Rows(a), Rows(b), Row(b=7))")
     gb_grid_s = time.perf_counter() - t0
-    return qps, bat_s, bytes_per_q, gb_s, gb_grid_s, spread
+    return qps, bat_s, p50_s, bytes_per_q, gb_s, gb_grid_s, spread
 
 
 def _cfg5_batch(rng, B):
@@ -379,7 +382,7 @@ def bench_config5(ex5, oracle_words, rng, budget_mb, resident):
             batches = [_cfg5_batch(rng, B) for _ in range(nb)]
             return _run_batches(ex5, "ssb1b", batches, T, shards_of=order)
 
-        (qps, bat_s), spread = best_of(run, n=reps)
+        (qps, bat_s, p50_s), spread = best_of(run, n=reps)
         stats = DEFAULT_BUDGET.stats()
         # per query: one pass over the subset's metric+seg stacked rows
         rows_touched = 8 + 4
@@ -387,6 +390,7 @@ def bench_config5(ex5, oracle_words, rng, budget_mb, resident):
         out = {
             "qps": round(qps, 1),
             "batch_ms": round(bat_s * 1e3, 1),
+            "batch_p50_ms": round(p50_s * 1e3, 1),
             "spread": spread,
             "gbps": round(qps * bytes_per_q / 1e9, 1),
             "columns": N_SHARDS5 << 20,
@@ -526,14 +530,20 @@ def bench_config5_distributed(rng):
         def run():
             batches = [(ports[i % 4], batch().encode())
                        for i in range(n_batches)]
+            lats = []
+
+            def post_one(pb):
+                t1 = time.perf_counter()
+                post(pb[0], "/index/dist/query", pb[1])
+                lats.append(time.perf_counter() - t1)
+
             t0 = time.perf_counter()
             with ThreadPoolExecutor(T) as pool:
-                list(pool.map(
-                    lambda pb: post(pb[0], "/index/dist/query", pb[1]),
-                    batches))
-            return B * n_batches / (time.perf_counter() - t0),
+                list(pool.map(post_one, batches))
+            return (B * n_batches / (time.perf_counter() - t0),
+                    float(np.median(lats)))
 
-        (qps,), spread = best_of(run)
+        (qps, p50_s), spread = best_of(run)
         (oracle_qps,), _ = best_of(
             lambda: (cpu_config5(oracle_words, range(N_SHARDS5D), rng),),
             n=2)
@@ -553,6 +563,7 @@ def bench_config5_distributed(rng):
 
         return {
             "qps": round(qps, 1),
+            "batch_p50_ms": round(p50_s * 1e3, 1),
             "spread": spread,
             "nodes": 4,
             "columns": N_SHARDS5D * SHARD_WIDTH,
@@ -694,10 +705,11 @@ def main():
     executor = Executor(holder, use_mesh=True)
     rng = np.random.default_rng(SEED + 1)
 
-    q1, l1, b1, s1 = bench_config1(executor, meta, rng)
-    q2, l2, b2, s2 = bench_config2(executor, meta, rng)
-    q3, l3, b3, s3 = bench_config3(executor, meta, rng)
-    q4, l4, b4, gb_s, gb_grid_s, s4 = bench_config4(executor, meta, rng)
+    q1, l1, p1, b1, s1 = bench_config1(executor, meta, rng)
+    q2, l2, p2, b2, s2 = bench_config2(executor, meta, rng)
+    q3, l3, p3, b3, s3 = bench_config3(executor, meta, rng)
+    q4, l4, p4, b4, gb_s, gb_grid_s, s4 = bench_config4(executor, meta,
+                                                        rng)
 
     (c1,), _ = best_of(lambda: (cpu_config1(holder, meta, rng),))
     (c2,), _ = best_of(lambda: (cpu_config2(holder, meta, rng),))
@@ -752,22 +764,26 @@ def main():
     configs = {
         "1_count_row_1shard": {
             "qps": round(q1, 1), "batch_ms": round(l1 * 1e3, 1),
+            "batch_p50_ms": round(p1 * 1e3, 1),
             "spread": s1, "vs_cpu": round(q1 / c1, 2),
             "cpu_qps": round(c1, 1),
             "gbps": round(q1 * b1 / 1e9, 1)},
         "2_intersect8_1M_cols": {
             "qps": round(q2, 1), "batch_ms": round(l2 * 1e3, 1),
+            "batch_p50_ms": round(p2 * 1e3, 1),
             "spread": s2, "vs_cpu": round(q2 / c2, 2),
             "cpu_qps": round(c2, 1),
             "gbps": round(q2 * b2 / 1e9, 1)},
         "3_topn_filtered_10M_cols": {
             "qps": round(q3, 1), "batch_ms": round(l3 * 1e3, 1),
+            "batch_p50_ms": round(p3 * 1e3, 1),
             "spread": s3, "vs_cpu": round(q3 / c3, 2),
             "cpu_qps": round(c3, 2),
             "gbps": round(q3 * b3 / 1e9, 1),
             "hbm_frac": round(q3 * b3 / 1e9 / HBM_PEAK_GBS, 3)},
         "4_bsi_sum_gt_64shards": {
             "qps": round(q4, 1), "batch_ms": round(l4 * 1e3, 1),
+            "batch_p50_ms": round(p4 * 1e3, 1),
             "spread": s4, "vs_cpu": round(q4 / c4, 2),
             "cpu_qps": round(c4, 2),
             "gbps": round(q4 * b4 / 1e9, 1),

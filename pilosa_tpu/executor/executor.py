@@ -14,7 +14,7 @@ from typing import Any
 
 import numpy as np
 
-from ..core import SHARD_WIDTH, VIEW_STANDARD
+from ..core import SHARD_WIDTH, SHARD_WORDS, VIEW_STANDARD
 from ..ops import bitset, bsi
 from ..pql import Call, parse
 from ..storage.field import FIELD_TYPE_INT, FIELD_TYPE_BOOL
@@ -107,8 +107,6 @@ def _batch_chunks(params_mat: np.ndarray, n_shards: int):
     ``n_shards`` is the per-device stacked-shard count — gather temps
     live per device, so the budget divides by the mesh size, not the
     total shard count."""
-    from ..core import SHARD_WORDS
-
     B, P = params_mat.shape
     weight = max(1, P) * max(1, n_shards) * SHARD_WORDS * 4
     chunk = max(BATCH_CHUNK_MIN,
@@ -155,7 +153,11 @@ def _run_batched_groups(mesh, holder, index, shards, groups, results):
             # fin=_sum_fin binds THIS group's finalizer: a free-variable
             # reference would late-bind to the last group's base when one
             # invocation carries several sum groups (the prepared path)
-            for lo, n_c, sub in _batch_chunks(params_mat, per_dev):
+            # a filter-less group (slotted None) has no per-row gather
+            # temps — the device path broadcasts one full pass — so
+            # splitting it would just repeat that pass per chunk
+            for lo, n_c, sub in _batch_chunks(
+                    params_mat, per_dev if slotted is not None else 0):
                 parts = mesh.bsi_sum_batch_async(
                     extra["field"], extra["view"], slotted, sub, holder,
                     index, shards)
@@ -168,7 +170,8 @@ def _run_batched_groups(mesh, holder, index, shards, groups, results):
                 return rank_counts(counts, n or None, ids)
 
             ids_n = extra["ids_n"]
-            for lo, n_c, sub in _batch_chunks(params_mat, per_dev):
+            for lo, n_c, sub in _batch_chunks(
+                    params_mat, per_dev if slotted is not None else 0):
                 parts = mesh.row_counts_batch_async(
                     extra["field"], extra["view"], slotted, sub, holder,
                     index, shards)
