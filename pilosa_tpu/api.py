@@ -128,6 +128,8 @@ class API:
             self.holder.delete_index(name)
         except ValueError as e:
             raise NotFoundError(str(e))
+        if self.cluster is not None:
+            self.cluster.forget_index_shards(name)
         self._broadcast({"type": "delete-index", "index": name})
 
     def create_field(self, index: str, field: str,

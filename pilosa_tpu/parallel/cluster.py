@@ -520,6 +520,17 @@ class Cluster:
         # window where nodes adopt the new membership at different times
         # while reads keep serving
         self.cleaner_grace = 5.0
+        # per-index remote shard availability, folded from every
+        # successful peer poll (the in-memory analog of field.go:263's
+        # gossiped available-shard bitmaps).  A DOWN peer's shards stay
+        # visible here, so a query over them FAILS loudly instead of
+        # silently shrinking to the live nodes' data.  Related but not
+        # redundant: Field.remote_available_shards records per-FIELD
+        # knowledge learned at import fan-out time; this map records
+        # per-INDEX knowledge learned from peer polls (the poll API is
+        # index-level).  Both feed the query scope; shards leave this
+        # map via forget_index_shards and resize data-loss pruning.
+        self._remote_shards: dict[str, set[int]] = {}
         self._load_topology()
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * len(self.nodes)))
@@ -646,6 +657,12 @@ class Cluster:
 
     # -- shard discovery ---------------------------------------------------
 
+    def forget_index_shards(self, index: str):
+        """Drop remembered remote shard availability for a deleted
+        index (both deletion paths — local API and cluster message —
+        funnel here)."""
+        self._remote_shards.pop(index, None)
+
     def _available_shards(self, index: str,
                           mark_down: bool = True) -> list[int]:
         """Union of local + peer available shards.  The reference gossips
@@ -657,14 +674,19 @@ class Cluster:
         flip the cluster DEGRADED."""
         idx = self.holder.index(index)
         shards = set(idx.available_shards()) if idx is not None else set()
+        known = self._remote_shards.setdefault(index, set())
         for n in self.peers():
             if n.state != NODE_READY:
                 continue
             try:
-                shards.update(self.client.available_shards(n.host, index))
+                known.update(self.client.available_shards(n.host, index))
             except Exception:
                 if mark_down:
                     self._mark_down(n.id)
+        # include every shard ever reported by a peer: a DOWN owner's
+        # shards must stay in the query's scope so the fan-out surfaces
+        # the failure instead of silently returning partial results
+        shards |= known
         return sorted(shards)
 
     # -- query fan-out (executor.go:2455 mapReduce) ------------------------
@@ -794,10 +816,25 @@ class Cluster:
             return out
         exclude: set[str] = set()
         pending = list(shards)
+        last_err: Exception | None = None
         for _attempt in range(len(self.nodes) + 1):
             if not pending:
                 break
-            groups = self._group_shards(index, pending, exclude)
+            try:
+                groups = self._group_shards(index, pending, exclude)
+            except ClusterError:
+                # re-admit owners that failed with an APPLICATION error
+                # (they responded — still READY): one failure is not
+                # death, so they get another pass.  Transport-failed
+                # owners were marked DOWN and stay excluded — a dead or
+                # partitioned sole owner must fail after ONE timeout,
+                # not len(nodes)+1 of them.
+                readmit = {nid for nid in exclude
+                           if self.by_id[nid].state == NODE_READY}
+                if not readmit:
+                    raise
+                exclude -= readmit
+                groups = self._group_shards(index, pending, exclude)
             futures = {}
             local_shards = groups.pop(self.node_id, None)
             for nid, nshards in groups.items():
@@ -821,18 +858,26 @@ class Cluster:
                                  max(elapsed - exec_s, 0.0))
                     for i, r in enumerate(res):
                         out[i].append(r)
-                except Exception:
+                except ClusterError as e:
+                    # the peer RESPONDED (HTTP error): it is alive, so an
+                    # application-level failure must not poison
+                    # membership — just retry these shards on a replica
+                    last_err = e
+                    exclude.add(nid)
+                    pending.extend(nshards)
+                except Exception as e:
+                    last_err = e
                     self._mark_down(nid)
                     exclude.add(nid)
                     pending.extend(nshards)
             if not pending:
                 break
         else:
-            raise ClusterError("query retries exhausted")
+            raise ClusterError("query retries exhausted") from last_err
         if pending:
             raise ClusterError(
                 f"no replicas available for shards {pending} of "
-                f"{index!r}")
+                f"{index!r}") from last_err
         return out
 
     def _execute_call(self, index: str, c: Call, shards: list[int]):
@@ -1177,6 +1222,7 @@ class Cluster:
                 msg["index"], keys=msg.get("keys", False),
                 track_existence=msg.get("trackExistence", True))
         elif t == "delete-index":
+            self.forget_index_shards(msg["index"])
             try:
                 holder.delete_index(msg["index"])
             except ValueError:
@@ -1694,6 +1740,8 @@ class Cluster:
             # under the new placement but does not own now, with a current
             # owner as source (cluster.go:784 fragSources)
             fetches: dict[str, list[dict]] = {nid: [] for nid in new_ids}
+            removed_ids = {n.id for n in removed}
+            lost: dict[str, set[int]] = {}
             for index_name in list(self.holder.indexes):
                 for s in self._available_shards(index_name):
                     old_owners = old_placement.shard_nodes(index_name, s)
@@ -1702,6 +1750,15 @@ class Cluster:
                         if o == self.node_id
                         or self.by_id[o].state == NODE_READY]
                     if not ready_sources:
+                        if all(o in removed_ids for o in old_owners):
+                            # every replica lives only on unreachable
+                            # nodes the operator is explicitly removing:
+                            # accept the data loss and forget the shard
+                            # (otherwise a dead ReplicaN=1 node could
+                            # never be removed — the resize would abort
+                            # on it forever)
+                            lost.setdefault(index_name, set()).add(s)
+                            continue
                         raise ClusterError(
                             f"no live source for shard {s} of "
                             f"{index_name!r}")
@@ -1766,6 +1823,10 @@ class Cluster:
                         "replicaN": 1, "epoch": new_epoch})
                 except Exception:
                     pass
+            for index_name, lost_shards in lost.items():
+                known = self._remote_shards.get(index_name)
+                if known is not None:
+                    known -= lost_shards
             if unacked:
                 # keep the job record: probe reconciliation (and a
                 # restart's _recover_resize_job) re-push resize-complete,

@@ -707,3 +707,144 @@ def test_cluster_hosts_config_no_crash(tmp_path):
     finally:
         for s in servers:
             s.close()
+
+
+def test_app_error_does_not_mark_peer_down(cluster3):
+    """A peer that RESPONDS with an HTTP error (application failure) is
+    alive: the fan-out must retry the shards on a replica without
+    poisoning membership (one bad query must not flip the cluster to
+    DEGRADED and reroute every later query)."""
+    from pilosa_tpu.parallel.cluster import ClusterError
+
+    setup_index(cluster3)
+    query(cluster3[0].port, "ci",
+          "Set(5, f=1) Set(2097200, f=1) Set(4194400, f=1)")
+    coord = cluster3[0].cluster
+    real = coord.client.query_calls
+    failed_hosts = []
+
+    def flaky(host, index, calls, shards):
+        if not failed_hosts:
+            failed_hosts.append(host)
+            raise ClusterError(f"{host}: 500 injected app error")
+        return real(host, index, calls, shards)
+
+    coord.client.query_calls = flaky
+    try:
+        [cnt] = query(cluster3[0].port, "ci", "Count(Row(f=1))")
+    finally:
+        coord.client.query_calls = real
+    assert cnt == 3
+    assert failed_hosts, "fan-out never reached a peer"
+    # the erroring peer must still be READY and the cluster NORMAL
+    assert all(n.state == "READY" for n in coord.nodes)
+    assert coord.state == "NORMAL"
+
+
+def test_sole_owner_transient_failure_retried(tmp_path):
+    """With ReplicaN=1 a shard has ONE owner; a single transient failure
+    of that owner must be retried against it (slow != dead) instead of
+    failing the query with 'no available node'."""
+    from pilosa_tpu.parallel.cluster import ClusterError
+
+    servers = make_cluster(tmp_path, n=2, replica_n=1)
+    try:
+        p0 = servers[0].port
+        _req(p0, "POST", "/index/ri", {})
+        _req(p0, "POST", "/index/ri/field/f", {})
+        query(p0, "ri", "Set(5, f=1) Set(2097200, f=1) Set(4194400, f=1)")
+        coord = servers[0].cluster
+        real = coord.client.query_calls
+        fails = []
+
+        def transient(host, index, calls, shards):
+            if not fails:
+                fails.append(host)
+                raise ClusterError(f"{host}: 500 transient")
+            return real(host, index, calls, shards)
+
+        coord.client.query_calls = transient
+        try:
+            [cnt] = query(p0, "ri", "Count(Row(f=1))")
+        finally:
+            coord.client.query_calls = real
+        assert cnt == 3
+        assert fails, "no peer-owned shard was exercised"
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
+def test_dead_sole_owner_fails_loud_not_partial(tmp_path):
+    """When the ONLY owner of some shards dies (ReplicaN=1), a read over
+    them must FAIL, not silently return the surviving nodes' partial
+    answer: remote shard availability is remembered across peer death
+    (field.go:263 remote available-shard tracking), so the fan-out still
+    covers the dead node's shards and surfaces the error."""
+    servers = make_cluster(tmp_path, n=2, replica_n=1)
+    try:
+        p0 = servers[0].port
+        _req(p0, "POST", "/index/lo", {})
+        _req(p0, "POST", "/index/lo/field/f", {})
+        query(p0, "lo", " ".join(
+            f"Set({s * SHARD_WIDTH + 9}, f=1)" for s in range(12)))
+        [cnt] = query(p0, "lo", "Count(Row(f=1))")
+        assert cnt == 12
+        owners = {s: servers[0].cluster.placement.shard_nodes("lo", s)[0]
+                  for s in range(12)}
+        assert "node1" in owners.values(), "placement never used node1"
+
+        servers[1].close()
+        servers[0].cluster.probe_peers()
+        assert servers[0].cluster.state == "DEGRADED"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            query(p0, "lo", "Count(Row(f=1))")
+        assert ei.value.code == 500
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
+def test_remove_dead_sole_owner_succeeds_with_data_loss(tmp_path):
+    """Removing a DEAD node whose shards had no replica (ReplicaN=1) must
+    complete the resize — accepting the loss of its unreplicated shards —
+    rather than aborting 'no live source' forever.  Queries afterwards
+    legitimately cover only the surviving shards."""
+    servers = make_cluster(tmp_path, n=2, replica_n=1)
+    try:
+        p0 = servers[0].port
+        _req(p0, "POST", "/index/rm", {})
+        _req(p0, "POST", "/index/rm/field/f", {})
+        query(p0, "rm", " ".join(
+            f"Set({s * SHARD_WIDTH + 9}, f=1)" for s in range(12)))
+        [cnt] = query(p0, "rm", "Count(Row(f=1))")
+        assert cnt == 12
+        cl = servers[0].cluster
+        node0_shards = [s for s in range(12)
+                        if cl.placement.shard_nodes("rm", s)[0] == "node0"]
+        assert 0 < len(node0_shards) < 12
+
+        servers[1].close()
+        cl.probe_peers()
+        assert cl.state == "DEGRADED"
+        # reads over the dead node's shards fail loudly...
+        with pytest.raises(urllib.error.HTTPError):
+            query(p0, "rm", "Count(Row(f=1))")
+        # ...until the operator explicitly removes the dead node
+        _req(p0, "POST", "/cluster/resize/remove-node", {"id": "node1"})
+        assert cl.state == "NORMAL"
+        assert len(cl.nodes) == 1
+        [cnt] = query(p0, "rm", "Count(Row(f=1))")
+        assert cnt == len(node0_shards)
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
