@@ -216,6 +216,7 @@ DEFAULT_CONFIG = """\
 data-dir = "~/.pilosa_tpu"
 bind = "localhost:10101"
 max-op-n = 10000
+# max-body-mb = 1024
 
 [cluster]
 # hosts = ["localhost:10101", "localhost:10102"]
@@ -245,6 +246,7 @@ def cmd_config(args) -> int:
     print(f"max-row-id = {cfg.max_row_id}")
     print(f"use-mesh = {str(cfg.use_mesh).lower()}")
     print(f"device-budget-mb = {cfg.device_budget_mb}")
+    print(f"max-body-mb = {cfg.max_body_mb}")
     print()
     print("[cluster]")
     print(f"hosts = [{', '.join(q(h) for h in cfg.cluster_hosts)}]")

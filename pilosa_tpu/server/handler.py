@@ -309,6 +309,11 @@ def build_router(api: API, server=None) -> Router:
 class _HandlerClass(BaseHTTPRequestHandler):
     router: Router = None
     protocol_version = "HTTP/1.1"
+    # Request-body ceiling: bounds a hostile/buggy client's ability to
+    # allocate host memory with one POST (bulk imports of a dense shard
+    # legitimately run to hundreds of MB, hence the generous default).
+    # <= 0 means unlimited, matching device-budget-mb's 0 convention.
+    max_body_bytes: int = 1 << 30
 
     # request helpers
     def json(self):
@@ -326,8 +331,30 @@ class _HandlerClass(BaseHTTPRequestHandler):
     def _handle(self, method: str):
         parsed = urlparse(self.path)
         self._query = parse_qs(parsed.query)
-        length = int(self.headers.get("Content-Length") or 0)
-        self.body = self.rfile.read(length) if length else b""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            # any body bytes in flight would desync the keep-alive
+            # stream (the next "request line" would be body garbage)
+            self.close_connection = True
+            self._send(400, {"error": "invalid Content-Length"})
+            return
+        if 0 < self.max_body_bytes < length:
+            # answer 413, then drain a bounded amount of the in-flight
+            # body so the client sees the response instead of an RST
+            # (closing with unread receive data resets the connection);
+            # bodies beyond the drain cap close hard anyway
+            self._send(413, {"error": f"request body {length} bytes "
+                             f"exceeds limit {self.max_body_bytes}"})
+            self.close_connection = True
+            remaining = min(length, 64 << 20)
+            while remaining > 0:
+                chunk = self.rfile.read(min(remaining, 1 << 20))
+                if not chunk:
+                    break
+                remaining -= len(chunk)
+            return
+        self.body = self.rfile.read(length) if length > 0 else b""
         fn, args = self.router.match(method, parsed.path)
         trace_id = self.headers.get(TRACE_HEADER)  # handler.go:231 extract
         try:
@@ -422,12 +449,16 @@ class TrackingHTTPServer(ThreadingHTTPServer):
 
 
 def make_http_server(api: API, host: str = "localhost", port: int = 10101,
-                     server=None, tls=None) -> ThreadingHTTPServer:
+                     server=None, tls=None,
+                     max_body_bytes: int | None = None) -> ThreadingHTTPServer:
     """``tls``: optional (certificate, key, ca_certificate|None) paths —
     serves HTTPS, requiring client certificates (mutual TLS) when a CA is
     given (reference server/tlsconfig.go, server/server.go GetTLSConfig)."""
     router = build_router(api, server)
-    cls = type("Handler", (_HandlerClass,), {"router": router})
+    attrs = {"router": router}
+    if max_body_bytes is not None:
+        attrs["max_body_bytes"] = max_body_bytes
+    cls = type("Handler", (_HandlerClass,), attrs)
     if tls is None:
         return TrackingHTTPServer((host, port), cls)
     import ssl

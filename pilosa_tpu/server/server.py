@@ -54,6 +54,10 @@ class Config:
     tls_key: str = ""
     tls_ca_certificate: str = ""
     tls_skip_verify: bool = False  # client side: don't verify peer certs
+    # HTTP request-body ceiling (MB); 413 above it, 0 = unlimited.
+    # Generous default: bulk imports of a dense shard legitimately run
+    # to hundreds of MB.
+    max_body_mb: int = 1024
     verbose: bool = False
 
     @classmethod
@@ -89,6 +93,7 @@ class Config:
             "PILOSA_TPU_TLS_CA_CERTIFICATE": ("tls_ca_certificate", str),
             "PILOSA_TPU_TLS_SKIP_VERIFY": (
                 "tls_skip_verify", lambda s: s == "true"),
+            "PILOSA_TPU_MAX_BODY_MB": ("max_body_mb", int),
         }
         for env, (attr, conv) in env_map.items():
             if env in os.environ:
@@ -112,6 +117,7 @@ class Config:
             "data-dir": "data_dir", "bind": "bind", "max-op-n": "max_op_n",
             "max-row-id": "max_row_id", "use-mesh": "use_mesh",
             "device-budget-mb": "device_budget_mb",
+            "max-body-mb": "max_body_mb",
         }
         for key, attr in mapping.items():
             if key in doc:
@@ -180,8 +186,9 @@ class Server:
                     self.config.tls_certificate, self.config.tls_key,
                     self.config.tls_ca_certificate or None,
                     self.config.tls_skip_verify)
-        self.httpd = make_http_server(self.api, host, port, server=self,
-                                      tls=tls)
+        self.httpd = make_http_server(
+            self.api, host, port, server=self, tls=tls,
+            max_body_bytes=self.config.max_body_mb << 20)
         from ..utils.diagnostics import DiagnosticsCollector
         self.diagnostics = DiagnosticsCollector(
             self, self.config.diagnostics_endpoint,

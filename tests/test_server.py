@@ -351,3 +351,54 @@ def test_topn_groupby_over_http(srv):
         {"group": [{"field": "f", "rowID": 1},
                    {"field": "g", "rowID": 5}], "count": 1},
     ]
+
+
+def test_body_size_limit(tmp_path):
+    """POST bodies above max-body-mb get 413 without buffering; a garbage
+    Content-Length gets 400 (both previously crashed or buffered
+    unbounded)."""
+    import http.client
+
+    cfg = Config(data_dir=str(tmp_path / "bl"), bind="localhost:0",
+                 max_body_mb=1)
+    s = Server(cfg)
+    s.open()
+    try:
+        code, err = call_err(s, "POST", "/index/big/query",
+                             b"x" * ((1 << 20) + 1))
+        assert code == 413 and "exceeds limit" in err["error"]
+        # a claimed-huge Content-Length is rejected without reading
+        conn = http.client.HTTPConnection("localhost", s.port, timeout=10)
+        conn.putrequest("POST", "/index/big/query")
+        conn.putheader("Content-Length", str(50 << 30))
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 413
+        conn.close()
+        # garbage Content-Length -> 400 AND the connection closes (any
+        # in-flight body bytes would desync the keep-alive stream)
+        conn = http.client.HTTPConnection("localhost", s.port, timeout=10)
+        conn.putrequest("POST", "/index/big/query")
+        conn.putheader("Content-Length", "banana")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 400
+        resp.read()
+        conn.close()
+        # a normal-size request still works
+        assert call(s, "POST", "/index/big", {}) == {}
+    finally:
+        s.close()
+
+    # 0 = unlimited (device-budget-mb convention)
+    cfg0 = Config(data_dir=str(tmp_path / "bl0"), bind="localhost:0",
+                  max_body_mb=0)
+    s0 = Server(cfg0)
+    s0.open()
+    try:
+        code, err = call_err(s0, "POST", "/index/big/query",
+                             b"Count(Row(f=1)) " * 200_000)  # ~3 MB
+        assert code == 400  # parses (index missing), not 413
+        assert "exceeds" not in err["error"]
+    finally:
+        s0.close()
