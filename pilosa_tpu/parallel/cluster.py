@@ -128,6 +128,14 @@ class InternalClient:
     InternalClient).  Hosts may carry an ``https://`` prefix; mutual-TLS
     client credentials come from ``configure_tls``."""
 
+    # Pooled connections idle longer than this are proactively replaced:
+    # servers close idle keep-alives after 120 s (handler timeout), and a
+    # connection the server already FIN'd often fails only at RESPONSE
+    # time — where POSTs must not retry (the peer may have executed the
+    # request).  Never reusing a socket old enough to be at risk keeps
+    # the narrow retry policy sound.
+    POOL_IDLE_MAX = 60.0
+
     def __init__(self, timeout: float = 30.0):
         self.timeout = timeout
         self._ssl_ctx = None
@@ -204,6 +212,12 @@ class InternalClient:
             # a pooled entry whose socket is gone (client.close() raced a
             # fan-out thread) is NOT a live keep-alive: replace it so it
             # re-registers and gets fresh-connection (no-retry) semantics
+            if conn is not None and conn.sock is not None and \
+                    time.monotonic() - getattr(
+                        conn, "_ptpu_last_use",
+                        time.monotonic()) > self.POOL_IDLE_MAX:
+                drop(conn)
+                conn = None
             reused = conn is not None and conn.sock is not None
             if conn is None or conn.sock is None:
                 if conn is not None:
@@ -234,6 +248,8 @@ class InternalClient:
                 raise
             if resp.will_close:
                 drop(conn)
+            else:
+                conn._ptpu_last_use = time.monotonic()
             return resp.status, data
 
     def _json(self, host, method, path, obj=None, timeout=None):

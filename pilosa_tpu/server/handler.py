@@ -309,6 +309,11 @@ def build_router(api: API, server=None) -> Router:
 class _HandlerClass(BaseHTTPRequestHandler):
     router: Router = None
     protocol_version = "HTTP/1.1"
+    # Socket read timeout: an idle keep-alive connection (or a client
+    # that opens a socket and sends nothing) must not pin a handler
+    # thread forever; pooled internal clients reconnect transparently
+    # on a closed stale socket (InternalClient stale-retry).
+    timeout = 120
     # Request-body ceiling: bounds a hostile/buggy client's ability to
     # allocate host memory with one POST (bulk imports of a dense shard
     # legitimately run to hundreds of MB, hence the generous default).

@@ -68,7 +68,10 @@ def _req(port, method, path, data=None):
             data).encode()
     r = urllib.request.Request(
         f"http://localhost:{port}{path}", method=method, data=body)
-    with urllib.request.urlopen(r) as resp:
+    # explicit timeout: urllib's default is infinite, and one hung
+    # request would wedge the whole suite (observed once under heavy
+    # concurrent load)
+    with urllib.request.urlopen(r, timeout=180) as resp:
         return json.loads(resp.read())
 
 
@@ -848,3 +851,33 @@ def test_remove_dead_sole_owner_succeeds_with_data_loss(tmp_path):
                 s.close()
             except Exception:
                 pass
+
+
+def test_pooled_conn_idle_replacement(cluster3, monkeypatch):
+    """A pooled keep-alive older than POOL_IDLE_MAX must be replaced
+    before reuse: the server closes idle connections (handler timeout),
+    and a FIN'd socket often fails only at response time, where POSTs
+    must not retry."""
+    import time as _time
+
+    from pilosa_tpu.parallel.cluster import InternalClient
+
+    setup_index(cluster3)
+    client = cluster3[0].cluster.client
+    host = cluster3[1].cluster.nodes[1].host
+    status, _ = client._request(host, "GET", "/status")
+    assert status == 200
+    first = client._local.conns[host]
+
+    monkeypatch.setattr(InternalClient, "POOL_IDLE_MAX", 0.05)
+    _time.sleep(0.1)
+    status, _ = client._request(host, "GET", "/status")
+    assert status == 200
+    assert client._local.conns[host] is not first  # replaced, not reused
+
+    # within the idle window the SAME connection is reused
+    second = client._local.conns[host]
+    monkeypatch.setattr(InternalClient, "POOL_IDLE_MAX", 60.0)
+    status, _ = client._request(host, "GET", "/status")
+    assert status == 200
+    assert client._local.conns[host] is second
