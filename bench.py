@@ -124,7 +124,7 @@ def build_indexes():
 N_SHARDS5 = 954  # ~1B columns (954 * 2^20)
 
 
-def build_config5(rng):
+def build_config5(rng, n_shards=N_SHARDS5):
     """~1B-column index: 954 shards, an 8-row metric field (~12.5% fill)
     and a 4-row segment field (~25% fill) — SSB lineorder flag/discount
     shaped.  At these densities every 65536-column container is a roaring
@@ -148,7 +148,7 @@ def build_config5(rng):
     seg_view = seg._create_view_if_not_exists(VIEW_STANDARD)
     met_view = metric._create_view_if_not_exists(VIEW_STANDARD)
     oracle_words: dict[int, np.ndarray] = {}
-    for shard in range(N_SHARDS5):
+    for shard in range(n_shards):
         a = rng.integers(0, 1 << 32, size=(12, SHARD_WORDS), dtype=np.uint32)
         b = rng.integers(0, 1 << 32, size=(12, SHARD_WORDS), dtype=np.uint32)
         words = a & b                      # ~25% fill
@@ -698,6 +698,80 @@ def bench_http(server_port, rng, n_rows):
     return (B * n_batches) / (time.perf_counter() - t0)
 
 
+def _smoke_norm(results):
+    """TopN results -> comparable (id, count) lists."""
+    return [[(p.id, p.count) for p in r] for r in results]
+
+
+def run_smoke():
+    """--smoke: seconds-scale end-to-end exercise of the resident AND the
+    budgeted/streaming query paths on tiny shard counts — wired as a
+    slow-marked pytest (tests/test_bench_smoke.py) so the streaming
+    pipeline is covered without bloating tier-1.  Asserts budgeted
+    results are identical to the resident run and that eviction,
+    streaming, and prefetch actually engaged; prints one JSON line."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage.membudget import DEFAULT_BUDGET
+
+    rng = np.random.default_rng(SEED + 2)
+    n_shards = 24
+    h5, oracle_words = build_config5(rng, n_shards=n_shards)
+    ex5 = Executor(h5, use_mesh=True)
+    old_limit = DEFAULT_BUDGET.limit_bytes
+    out = {"smoke": True, "shards": n_shards}
+    t_start = time.perf_counter()
+    try:
+        subsets = [list(map(int, s))
+                   for s in np.array_split(np.arange(n_shards), 4)]
+        batches = [_cfg5_batch(rng, 8) for _ in range(6)]
+        full_q = "TopN(metric, Intersect(Row(seg=0), Row(seg=2)), n=5)"
+
+        # resident pass: no limit, everything stays staged
+        DEFAULT_BUDGET.limit_bytes = None
+        want = [ex5.execute("ssb1b", b, shards=subsets[i % 4])
+                for i, b in enumerate(batches)]
+        want_full = ex5.execute("ssb1b", full_q)
+        assert _smoke_norm(want_full)[0] == \
+            oracle_topn5(oracle_words, range(n_shards), 0, 2), \
+            "resident answer diverged from the oracle"
+
+        # budgeted pass: limit sized so two subset stacks cannot both
+        # stay resident (per-subset ~12 MB stacked) and a full-shard
+        # pass (~38 MB) must stream in slices with prefetch
+        DEFAULT_BUDGET.limit_bytes = 20 << 20
+        DEFAULT_BUDGET.shrink_to_limit()
+        ev0 = DEFAULT_BUDGET.evictions
+        pf0 = DEFAULT_BUDGET.prefetch_hits + DEFAULT_BUDGET.prefetch_misses
+        t0 = time.perf_counter()
+        got = [ex5.execute("ssb1b", b, shards=subsets[i % 4])
+               for i, b in enumerate(batches)]
+        got_full = ex5.execute("ssb1b", full_q)
+        budgeted_s = time.perf_counter() - t0
+        for w, g in zip(want, got):
+            assert _smoke_norm(w) == _smoke_norm(g), \
+                "budgeted subset results diverged from the resident run"
+        assert _smoke_norm(want_full) == _smoke_norm(got_full), \
+            "streamed full-pass result diverged from the resident run"
+        stats = DEFAULT_BUDGET.stats()
+        assert DEFAULT_BUDGET.evictions > ev0, \
+            "budget never evicted under the smoke limit"
+        assert stats["prefetchHits"] + stats["prefetchMisses"] > pf0, \
+            "streaming prefetch never engaged on the over-budget pass"
+        out.update({
+            "budgeted_s": round(budgeted_s, 2),
+            "evictions": DEFAULT_BUDGET.evictions - ev0,
+            "prefetch_hits": stats["prefetchHits"],
+            "prefetch_misses": stats["prefetchMisses"],
+            "upload_mb": stats["uploadBytes"] >> 20,
+            "pinned_bytes": stats["pinnedBytes"],
+        })
+    finally:
+        DEFAULT_BUDGET.limit_bytes = old_limit
+        ex5.close()
+    out["total_s"] = round(time.perf_counter() - t_start, 2)
+    print(json.dumps(out))
+
+
 def main():
     from pilosa_tpu.executor import Executor
 
@@ -808,4 +882,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--smoke" in sys.argv:
+        run_smoke()
+    else:
+        main()

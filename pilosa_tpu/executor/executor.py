@@ -19,7 +19,7 @@ from ..ops import bitset, bsi
 from ..pql import Call, parse
 from ..storage.field import FIELD_TYPE_INT, FIELD_TYPE_BOOL
 from ..storage import time_quantum as tq
-from .plan import PlanCompiler, Resolver, parametrize
+from .plan import PlanCompiler, Resolver, parametrize, plan_inputs
 from .results import (
     FieldRow, GroupCount, Pair, RowIdentifiers, RowResult, ValCount,
     acc_counts, rank_counts, sort_pairs,
@@ -106,12 +106,18 @@ def _batch_chunks(params_mat: np.ndarray, n_shards: int):
     rows beyond n are duplicates whose results the caller ignores.
     ``n_shards`` is the per-device stacked-shard count — gather temps
     live per device, so the budget divides by the mesh size, not the
-    total shard count."""
+    total shard count.  ``n_shards <= 0`` marks a filter-less group whose
+    device pass is a B-independent broadcast: it dispatches as ONE chunk
+    regardless of B (splitting would repeat the full fragment pass per
+    chunk — r5 advisor, the old path still cut at BATCH_CHUNK_MAX)."""
     B, P = params_mat.shape
-    weight = max(1, P) * max(1, n_shards) * SHARD_WORDS * 4
-    chunk = max(BATCH_CHUNK_MIN,
-                min(BATCH_CHUNK_MAX, BATCH_TEMP_BYTES // weight))
-    chunk = 1 << (chunk.bit_length() - 1)
+    if n_shards <= 0:
+        chunk = max(BATCH_CHUNK_MIN, B)
+    else:
+        weight = max(1, P) * n_shards * SHARD_WORDS * 4
+        chunk = max(BATCH_CHUNK_MIN,
+                    min(BATCH_CHUNK_MAX, BATCH_TEMP_BYTES // weight))
+        chunk = 1 << (chunk.bit_length() - 1)
     for lo in range(0, B, chunk):
         sub = params_mat[lo: lo + chunk]
         n = sub.shape[0]
@@ -122,6 +128,16 @@ def _batch_chunks(params_mat: np.ndarray, n_shards: int):
         yield lo, n, sub
 
 
+def _group_key_list(mesh, kind, slotted, extra):
+    """The exact (field, view) key list the mesh dispatch for this group
+    will stack (mesh.batch_keys is the single definition), so the shard
+    schedule's prefetch stages the stacks the dispatch will actually
+    read."""
+    if kind == "count":
+        return plan_inputs(slotted)
+    return mesh.batch_keys((extra["field"], extra["view"]), slotted)
+
+
 def _run_batched_groups(mesh, holder, index, shards, groups, results):
     """Dispatch batched call groups chunk-wise and fill ``results``.
 
@@ -129,17 +145,69 @@ def _run_batched_groups(mesh, holder, index, shards, groups, results):
     extra carries kind-specific fields — sum: field/view/base, topn:
     field/view/ids_n with one (ids, n) pair per call.  Shared by the
     classic grouped path and the prepared-statement cache so the chunking
-    policy lives in exactly one place."""
-    per_dev = mesh.stacked_per_device(len(shards))
-    for kind, slotted, params_mat, call_idxs, extra in groups:
-        if kind == "count":
-            for lo, n_c, sub in _batch_chunks(params_mat, per_dev):
-                parts = mesh.count_batch_async(slotted, sub, holder,
-                                               index, shards)
-                grp = _PendingGroup.counts(parts, call_idxs[lo: lo + n_c])
-                for i in call_idxs[lo: lo + n_c]:
-                    results[i] = grp
-        elif kind == "sum":
+    policy lives in exactly one place.
+
+    Dispatch order is SLICE-MAJOR over one residency-aware shard schedule
+    covering the whole batch: every group's every chunk runs against a
+    shard slice before the budget rotates to the next slice.  Chunk-major
+    order re-staged the full over-budget working set once per chunk;
+    slice-major pays the rotation once for the entire batch, with the
+    next slice prefetching while the current one computes.  When the
+    working set fits the budget the schedule is a single slice and this
+    is exactly the old dispatch."""
+    groups = list(groups)
+    if not groups:
+        return
+
+    key_lists: list = []
+    for kind, slotted, _pm, _ci, extra in groups:
+        kl = _group_key_list(mesh, kind, slotted, extra)
+        if kl not in key_lists:
+            key_lists.append(kl)
+    sched = mesh.shard_schedule(holder, index, key_lists, shards)
+    # chunk layout must be identical across slices so per-chunk parts can
+    # accumulate; size by the largest slice (conservative for the rest)
+    per_dev = mesh.stacked_per_device(sched.max_slice_len)
+
+    def _n_split(kind, slotted):
+        # count plans always gather per-row temps; sum/topn without a
+        # filter broadcast one pass — single chunk (see _batch_chunks)
+        return per_dev if (kind == "count" or slotted is not None) else 0
+
+    # chunk layouts (and their padded device params) computed ONCE:
+    # slice-major iteration would otherwise repeat the concatenate
+    # padding and the host->device params transfer per slice on
+    # identical data
+    import jax.numpy as jnp
+    group_chunks = [
+        [(lo, n_c, jnp.asarray(sub)) for lo, n_c, sub in
+         _batch_chunks(params_mat, _n_split(kind, slotted))]
+        for kind, slotted, params_mat, _ci, extra in groups]
+
+    parts_acc: dict[tuple[int, int], list] = {}
+    for shard_slice in sched:
+        for gi, (kind, slotted, params_mat, call_idxs, extra) \
+                in enumerate(groups):
+            for lo, _n, sub in group_chunks[gi]:
+                if kind == "count":
+                    parts = mesh.count_batch_async(
+                        slotted, sub, holder, index, shard_slice)
+                elif kind == "sum":
+                    parts = mesh.bsi_sum_batch_async(
+                        extra["field"], extra["view"], slotted, sub,
+                        holder, index, shard_slice)
+                else:  # topn
+                    parts = mesh.row_counts_batch_async(
+                        extra["field"], extra["view"], slotted, sub,
+                        holder, index, shard_slice)
+                parts_acc.setdefault((gi, lo), []).extend(parts)
+
+    # all parts dispatched; build the pendings (finalizers sum/merge the
+    # per-slice parts exactly as they previously merged per-shape-group
+    # parts — every reduction here is additive over shards)
+    for gi, (kind, slotted, params_mat, call_idxs, extra) \
+            in enumerate(groups):
+        if kind == "sum":
             base = extra["base"]
 
             def _sum_fin(hp, b, base=base):
@@ -149,32 +217,26 @@ def _run_batched_groups(mesh, holder, index, shards, groups, results):
                     total += s
                     cnt += c_
                 return ValCount(total + cnt * base, cnt)
-
-            # fin=_sum_fin binds THIS group's finalizer: a free-variable
-            # reference would late-bind to the last group's base when one
-            # invocation carries several sum groups (the prepared path)
-            # a filter-less group (slotted None) has no per-row gather
-            # temps — the device path broadcasts one full pass — so
-            # splitting it would just repeat that pass per chunk
-            for lo, n_c, sub in _batch_chunks(
-                    params_mat, per_dev if slotted is not None else 0):
-                parts = mesh.bsi_sum_batch_async(
-                    extra["field"], extra["view"], slotted, sub, holder,
-                    index, shards)
-                for b in range(n_c):
-                    results[call_idxs[lo + b]] = _Pending(
-                        parts, lambda hp, b=b, fin=_sum_fin: fin(hp, b))
-        else:  # topn
+        elif kind == "topn":
             def _topn_fin(hp, b, ids, n):
                 counts = mesh.merge_counts([p[b] for p in hp])
                 return rank_counts(counts, n or None, ids)
 
             ids_n = extra["ids_n"]
-            for lo, n_c, sub in _batch_chunks(
-                    params_mat, per_dev if slotted is not None else 0):
-                parts = mesh.row_counts_batch_async(
-                    extra["field"], extra["view"], slotted, sub, holder,
-                    index, shards)
+        for lo, n_c, _sub in group_chunks[gi]:
+            parts = parts_acc.get((gi, lo), [])
+            if kind == "count":
+                grp = _PendingGroup.counts(parts, call_idxs[lo: lo + n_c])
+                for i in call_idxs[lo: lo + n_c]:
+                    results[i] = grp
+            elif kind == "sum":
+                # fin=_sum_fin binds THIS group's finalizer: a free-
+                # variable reference would late-bind to the last group's
+                # base when one invocation carries several sum groups
+                for b in range(n_c):
+                    results[call_idxs[lo + b]] = _Pending(
+                        parts, lambda hp, b=b, fin=_sum_fin: fin(hp, b))
+            else:
                 for b in range(n_c):
                     ids, n = ids_n[lo + b]
                     results[call_idxs[lo + b]] = _Pending(
@@ -367,6 +429,7 @@ class Executor:
 
         results: list = [None] * len(calls)
         batched: set[int] = set()
+        to_run = []
         for key, idxs in groups.items():
             if len(idxs) < 2:
                 continue
@@ -381,11 +444,13 @@ class Executor:
                          "ids_n": [(d["ids"], d["n"]) for d in ds]}
             else:
                 extra = None
-            _run_batched_groups(
-                self.mesh_exec, self.holder, index, shards,
-                [(kind, ds[0]["slotted"], params_mat, idxs, extra)],
-                results)
+            to_run.append((kind, ds[0]["slotted"], params_mat, idxs, extra))
             batched.update(idxs)
+        # ONE invocation for every group: they share one residency-aware
+        # shard schedule, so under budget pressure the whole multi-group
+        # batch drains against each shard slice before the budget rotates
+        _run_batched_groups(self.mesh_exec, self.holder, index, shards,
+                            to_run, results)
 
         for i, c in enumerate(calls):
             if i not in batched:

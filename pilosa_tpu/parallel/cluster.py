@@ -546,7 +546,12 @@ class Cluster:
         # per-INDEX knowledge learned from peer polls (the poll API is
         # index-level).  Both feed the query scope; shards leave this
         # map via forget_index_shards and resize data-loss pruning.
+        # Mutated from concurrent query threads (peer polls) AND cluster
+        # messages; _shards_lock (a leaf lock, never held across I/O or
+        # another lock) guards every access instead of leaning on GIL
+        # atomicity of single set ops (r5 advisor).
         self._remote_shards: dict[str, set[int]] = {}
+        self._shards_lock = threading.Lock()
         self._load_topology()
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * len(self.nodes)))
@@ -677,7 +682,8 @@ class Cluster:
         """Drop remembered remote shard availability for a deleted
         index (both deletion paths — local API and cluster message —
         funnel here)."""
-        self._remote_shards.pop(index, None)
+        with self._shards_lock:
+            self._remote_shards.pop(index, None)
 
     def _available_shards(self, index: str,
                           mark_down: bool = True) -> list[int]:
@@ -690,19 +696,22 @@ class Cluster:
         flip the cluster DEGRADED."""
         idx = self.holder.index(index)
         shards = set(idx.available_shards()) if idx is not None else set()
-        known = self._remote_shards.setdefault(index, set())
         for n in self.peers():
             if n.state != NODE_READY:
                 continue
             try:
-                known.update(self.client.available_shards(n.host, index))
+                got = self.client.available_shards(n.host, index)
             except Exception:
                 if mark_down:
                     self._mark_down(n.id)
+                continue
+            with self._shards_lock:
+                self._remote_shards.setdefault(index, set()).update(got)
         # include every shard ever reported by a peer: a DOWN owner's
         # shards must stay in the query's scope so the fan-out surfaces
         # the failure instead of silently returning partial results
-        shards |= known
+        with self._shards_lock:
+            shards |= self._remote_shards.get(index, set())
         return sorted(shards)
 
     # -- query fan-out (executor.go:2455 mapReduce) ------------------------
@@ -1665,6 +1674,7 @@ class Cluster:
         done_msg = {"type": "resize-complete",
                     "membership": job["membership"],
                     "replicaN": job.get("replicaN", self.replica_n),
+                    "lostShards": job.get("lostShards", {}),
                     "epoch": epoch}
         ok = True
         # short per-send timeout: this runs inside Server.open(), and an
@@ -1804,9 +1814,15 @@ class Cluster:
             # superset, so completion is always safe, while a partial
             # completion with no record could never reconverge.
             new_epoch = self.epoch + 1
+            # data-loss shards ride the resize-complete broadcast so EVERY
+            # node prunes them from its availability maps — coordinator-
+            # only pruning let peer polls re-propagate forgotten shards
+            # back into query scope forever (r5 advisor)
+            lost_wire = {idx: sorted(s) for idx, s in lost.items()}
             self._save_resize_job({
                 "epoch": new_epoch, "membership": new_membership,
                 "replicaN": self.replica_n,
+                "lostShards": lost_wire,
                 "removed": [{"id": n.id, "uri": n.host} for n in removed]})
             completed = True  # phase-1 abort path no longer applies
             # phase 2: peers adopt FIRST, with retries; the coordinator
@@ -1816,6 +1832,7 @@ class Cluster:
             done_msg = {"type": "resize-complete",
                         "membership": new_membership,
                         "replicaN": self.replica_n,
+                        "lostShards": lost_wire,
                         "epoch": new_epoch}
             unacked = {nid for nid in new_ids if nid != self.node_id}
             for _ in range(3):
@@ -1839,10 +1856,6 @@ class Cluster:
                         "replicaN": 1, "epoch": new_epoch})
                 except Exception:
                     pass
-            for index_name, lost_shards in lost.items():
-                known = self._remote_shards.get(index_name)
-                if known is not None:
-                    known -= lost_shards
             if unacked:
                 # keep the job record: probe reconciliation (and a
                 # restart's _recover_resize_job) re-push resize-complete,
@@ -1903,6 +1916,26 @@ class Cluster:
         retry, crash recovery, probe reconciliation) for an epoch we
         already hold is an idempotent no-op ack."""
         msg_epoch = int(msg.get("epoch", self.epoch + 1))
+        if msg_epoch > self.epoch:
+            # data-loss prune, on FIRST application of an epoch only:
+            # shards forgotten in a data-loss removal leave this node's
+            # per-index AND per-field availability maps, or its poll
+            # replies would re-propagate them cluster-wide.  A re-driven
+            # duplicate (same or older epoch — coordinator retry, probe
+            # reconciliation) must NOT re-prune: the shards may have been
+            # legitimately re-imported since the first application.
+            for index_name, lost_list in \
+                    (msg.get("lostShards") or {}).items():
+                drop = {int(s) for s in lost_list}
+                with self._shards_lock:
+                    known = self._remote_shards.get(index_name)
+                    if known is not None:
+                        known -= drop
+                idx = self.holder.index(index_name) if self.holder \
+                    else None
+                if idx is not None:
+                    for f in idx.fields.values():
+                        f.remote_available_shards -= drop
         if msg_epoch <= self.epoch:
             if self.state == STATE_RESIZING:
                 self.state = STATE_NORMAL

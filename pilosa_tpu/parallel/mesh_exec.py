@@ -31,9 +31,22 @@ Reducers (each one compiled executable per input-shape signature):
 
 On a single device this degrades gracefully to one stacked call (still
 better than per-shard dispatch given the ~100 ms tunnel round-trip floor).
+
+When a query's stacked working set exceeds the device budget, execution
+STREAMS: the shard list is carved into slices of at most half the budget,
+slices whose stacks are already resident are drained first, and while the
+current slice's dispatch runs, a background uploader stages the next one
+(sparse->dense expansion via the host staging cache + ``jax.device_put``
+off the critical path) — double-buffering within the budget so over-budget
+queries run at upload bandwidth instead of serialized miss latency (the
+HBM analog of the reference's page-cache read-ahead over mmap'd fragments,
+fragment.go:311).  In-use and prefetched slices are pinned in the budget
+so concurrent staging cannot evict them mid-use (docs/memory-budget.md).
 """
 
 from __future__ import annotations
+
+from concurrent import futures
 
 import jax
 import jax.numpy as jnp
@@ -44,7 +57,30 @@ from ..core import SHARD_WORDS
 from ..ops import bsi
 from ..executor.plan import eval_plan, parametrize, plan_inputs
 
+# shard_map moved from jax.experimental (kwarg check_rep) to the jax
+# namespace (kwarg check_vma) across jax releases; gate on what this
+# runtime provides so both work.
+if hasattr(jax, "shard_map"):
+    _shard_map, _SM_CHECK_KW = jax.shard_map, "check_vma"
+else:  # jax < 0.5
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _SM_CHECK_KW = "check_rep"
+
 SHARD_AXIS = "shards"
+
+# Multi-device collective programs must be ENQUEUED in one consistent
+# order across all device queues: two threads (concurrent server
+# requests, or the prefetch uploader racing a dispatch) interleaving
+# psum/all_gather program launches wedge the per-device queues into a
+# circular rendezvous wait (reproduced on the 8-virtual-device CPU
+# platform: rank k stuck on RunId A while the rest wait on RunId B —
+# XLA collective_ops_utils "may be stuck").  One process-wide lock
+# around every collective-program LAUNCH (shard_map executables and
+# sharded-output indexing) restores a global enqueue order; execution
+# itself stays async and overlapped, only the enqueue serializes.
+import threading as _threading
+
+_DISPATCH_LOCK = _threading.Lock()
 
 
 def default_mesh(devices=None) -> Mesh:
@@ -90,6 +126,10 @@ class MeshExecutor:
         self._stack_cache: OrderedDict = OrderedDict()
         self.stack_cache_max = 64
         self._budget = DEFAULT_BUDGET
+        # single-worker background uploader for streamed shard slices
+        # (created on first over-budget query; one worker serializes
+        # prefetch transfers so they never contend with each other)
+        self._uploader = None
         # Leaf lock for _stack_cache dict ops ONLY (never held across any
         # other lock acquisition): budget-eviction callbacks and query
         # threads race on the dict, and a callback taking the main
@@ -115,10 +155,10 @@ class MeshExecutor:
         shard_map's static varying-axes checker cannot infer that."""
         fn = self._cache.get(key)
         if fn is None:
-            fn = jax.jit(jax.shard_map(
+            fn = jax.jit(_shard_map(
                 block_fn, mesh=self.mesh,
                 in_specs=in_specs, out_specs=out_specs,
-                check_vma=check_vma))
+                **{_SM_CHECK_KW: check_vma}))
             self._cache[key] = fn
         return fn
 
@@ -188,10 +228,7 @@ class MeshExecutor:
         pop entries concurrently from outside ``self._lock`` (it must not
         lock: two executors evicting each other's entries would deadlock),
         so every cache op here tolerates a vanished key."""
-        frags = [[holder.fragment(index, field, view, shard)
-                  for field, view in keys] for shard in shards]
-        token = tuple(-1 if fr is None else fr.gen
-                      for row in frags for fr in row)
+        frags, token = self._stack_token(keys, holder, index, shards)
         ckey = (index, tuple(keys), tuple(shards))
         skey = ("stack", id(self), ckey)
         with self._sc_lock:
@@ -277,6 +314,26 @@ class MeshExecutor:
         return out
 
     @staticmethod
+    def _stack_token(keys, holder, index, shards):
+        """(per-shard fragment rows, data-generation token) for a stacked
+        block — the token keys cache validity (gens are unique per
+        mutation, so equality means identical data)."""
+        frags = [[holder.fragment(index, field, view, shard)
+                  for field, view in keys] for shard in shards]
+        token = tuple(-1 if fr is None else fr.gen
+                      for row in frags for fr in row)
+        return frags, token
+
+    def _is_resident(self, keys, holder, index, shards) -> bool:
+        """Whether this (keys, shards) stack is cached AND current — the
+        residency signal the streaming scheduler orders slices by."""
+        _, token = self._stack_token(keys, holder, index, shards)
+        with self._sc_lock:
+            cached = self._stack_cache.get(
+                (index, tuple(keys), tuple(shards)))
+        return cached is not None and cached[0] == token
+
+    @staticmethod
     def _cleanup_budget(budget, exec_id, stack_cache):
         """Drop this executor's budget accounting (runs on close() or GC —
         without it, accounting-only budgets would grow phantom resident
@@ -289,8 +346,19 @@ class MeshExecutor:
         """Unregister budget entries and drop cached device state (also
         runs automatically when an un-closed executor is GC'd)."""
         with self._lock:
+            if self._uploader is not None:
+                self._uploader.shutdown(wait=True, cancel_futures=True)
+                self._uploader = None
             self._finalizer()
             self._cache.clear()
+
+    def _uploader_pool(self):
+        with self._lock:
+            if self._uploader is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._uploader = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="ptpu-prefetch")
+            return self._uploader
 
     def _bucket(self, n: int) -> int:
         """Stacked shard counts round UP to n_devices * 2^k: executables
@@ -337,7 +405,10 @@ class MeshExecutor:
 
         def fill(block, lo):
             for i in range(lo, min(lo + block.shape[0], n)):
-                dense = frs[i].to_dense()
+                # staged_dense: re-stages after an HBM eviction copy from
+                # the host staging cache instead of re-expanding the
+                # sparse store (read-only — the slice-assign copies)
+                dense = frs[i].staged_dense()
                 r = min(dense.shape[0], shape[0])  # cap may race a grow
                 block[i - lo, :r] = dense[:r]
             return block
@@ -362,6 +433,101 @@ class MeshExecutor:
     def _filter_keys(self, filter_plan) -> list[tuple[str, str]]:
         return plan_inputs(filter_plan) if filter_plan is not None else []
 
+    def batch_keys(self, primary: tuple[str, str],
+                   filter_plan) -> list[tuple[str, str]]:
+        """The exact stacked key list for a primary-fragment dispatch
+        with an optional (slotted) filter plan.  The ONLY definition —
+        executor._group_key_list calls this so the shard schedule
+        prefetches and pins precisely the stacks the dispatch reads; a
+        divergent copy would silently turn prefetching into waste."""
+        return [primary] + [k for k in self._filter_keys(filter_plan)
+                            if k != primary]
+
+    # -- out-of-core shard streaming --------------------------------------
+
+    # Slice target as a fraction of the budget: half, so the next slice
+    # can stage (double-buffered) while the current one computes without
+    # the pair exceeding the limit.
+    STREAM_SLICE_FRACTION = 0.5
+
+    def _estimate_shard_bytes(self, keys, holder, index, shards):
+        """Per-shard stacked bytes over ``keys`` (bucket padding excluded:
+        this sizes slices, padding is zeros shared across them)."""
+        out = []
+        for shard in shards:
+            b = 0
+            for field, view in keys:
+                fr = holder.fragment(index, field, view, shard)
+                if fr is not None:
+                    b += fr.n_rows * SHARD_WORDS * 4
+            out.append(b)
+        return out
+
+    def shard_schedule(self, holder, index, key_lists, shards):
+        """Residency-aware shard-group schedule for a dispatch that will
+        stack ``key_lists`` (one key list per distinct stacked block) over
+        ``shards``.
+
+        Fits-in-budget working sets (or an unlimited budget, or a
+        multi-process mesh, whose staging must stay deterministic across
+        processes) get ONE slice — the whole shard list, with cache keys
+        identical to the pre-streaming path.  Over-budget sets are carved
+        into contiguous slices of at most STREAM_SLICE_FRACTION of the
+        budget; slices already resident are ordered FIRST so a batch
+        drains all work against staged data before rotating the budget,
+        and iteration prefetches slice k+1 while slice k dispatches."""
+        shards = list(shards)
+        # bytes are estimated per key LIST occurrence, not the union:
+        # each list stages its own stacked block, so a key shared by two
+        # lists occupies device memory twice — union-sizing would let
+        # the pinned current+prefetched pair exceed the budget
+        all_keys: list = [k for kl in key_lists for k in kl]
+        limit = self._budget.limit_bytes
+        slices = [shards]
+        if limit and not self.multiprocess and \
+                len(shards) > self.n_devices:
+            per = self._estimate_shard_bytes(all_keys, holder, index,
+                                             shards)
+            if sum(per) > limit:
+                target = max(1, int(limit * self.STREAM_SLICE_FRACTION))
+                # contiguous cuts, deterministic for a given (shards,
+                # limit) so repeat queries hit the same slice cache keys;
+                # never below n_devices shards per slice — _bucket would
+                # pad a smaller slice back to a full mesh width of zero
+                # blocks, re-inflating the memory the cut tried to save
+                slices, cur, cur_b = [], [], 0
+                for s, b in zip(shards, per):
+                    if cur_b + b > target and len(cur) >= self.n_devices:
+                        slices.append(cur)
+                        cur, cur_b = [], 0
+                    cur.append(s)
+                    cur_b += b
+                if slices and len(cur) < self.n_devices:
+                    slices[-1].extend(cur)  # tail can't fill the mesh
+                elif cur:
+                    slices.append(cur)
+                if len(slices) > 1:
+                    # drain resident slices first (stable within each
+                    # class so rotation order stays deterministic)
+                    res = [all(self._is_resident(kl, holder, index, sl)
+                               for kl in key_lists) for sl in slices]
+                    slices = [sl for sl, r in zip(slices, res) if r] + \
+                        [sl for sl, r in zip(slices, res) if not r]
+        return _ShardSchedule(self, holder, index, key_lists, slices)
+
+    def _pin_stack(self, keys, index, shard_slice) -> tuple | None:
+        skey = ("stack", id(self),
+                (index, tuple(keys), tuple(shard_slice)))
+        return skey if self._budget.pin(skey) else None
+
+    def _stream_groups(self, keys, holder, index, shards):
+        """``_placed_groups`` over the streaming schedule: the default
+        iteration surface for every dispatch entry point.  Single-slice
+        schedules (the common, fits-in-budget case) behave exactly like a
+        direct ``_placed_groups`` call."""
+        for sl in self.shard_schedule(holder, index, [keys], shards):
+            yield from self._placed_groups(keys, holder, index, sl)
+
     # -- public entry points ----------------------------------------------
 
     def count_async(self, plan, holder, index, shards) -> list:
@@ -373,40 +539,50 @@ class MeshExecutor:
         slotted, params = parametrize(plan)
         params = jnp.asarray(params)
         parts = []
-        for shard_list, placed, sig in self._placed_groups(
+        for shard_list, placed, sig in self._stream_groups(
                 keys, holder, index, shards):
             if all(s is None for s in sig):
                 continue  # no fragments -> plan evaluates to empty
             present = self._present(keys, placed, sig)
             fn = self._compiled(slotted, tuple(k for k, _, _ in present),
                                 tuple(s for _, _, s in present), "count")
-            parts.append(fn(params, *[a for _, a, _ in present]))
+            with _DISPATCH_LOCK:
+                parts.append(fn(params, *[a for _, a, _ in present]))
         return parts
 
     def count(self, plan, holder, index, shards) -> int:
         return sum(int(x) for x in self.count_async(
             plan, holder, index, shards))
 
-    def segments(self, plan, holder, index, shards) -> dict[int, jax.Array]:
+    def segments(self, plan, holder, index, shards) -> dict[int, np.ndarray]:
         from ..core import SHARD_WORDS
 
         keys = plan_inputs(plan)
         slotted, params = parametrize(plan)
         params = jnp.asarray(params)
-        out: dict[int, jax.Array] = {}
-        for shard_list, placed, sig in self._placed_groups(
+        out: dict[int, np.ndarray] = {}
+        for shard_list, placed, sig in self._stream_groups(
                 keys, holder, index, shards):
             if all(s is None for s in sig):
-                zero = jnp.zeros(SHARD_WORDS, dtype=jnp.uint32)
+                zero = np.zeros(SHARD_WORDS, dtype=np.uint32)
                 for shard in shard_list:
                     out[shard] = zero
                 continue
             present = self._present(keys, placed, sig)
             fn = self._compiled(slotted, tuple(k for k, _, _ in present),
                                 tuple(s for _, _, s in present), None)
-            segs = fn(params, *[a for _, a, _ in present])
+            with _DISPATCH_LOCK:
+                segs = fn(params, *[a for _, a, _ in present])
+            # ONE addressable-shard host assembly.  Indexing the sharded
+            # output per row (`segs[i]`) launched a collective reshard
+            # program per shard, and per-row collectives from concurrent
+            # request threads wedged XLA's device queues (rendezvous
+            # circular wait); device_get copies shards with no collective.
+            # Consumers (serialization, Store, filter masks) all coerce
+            # to host or mix numpy into jnp ops anyway.
+            host = np.asarray(jax.device_get(segs))
             for i, shard in enumerate(shard_list):
-                out[shard] = segs[i]
+                out[shard] = host[i]
         return out
 
     # -- row_counts: TopN/Rows/MinRow/MaxRow (fragment.go:1570 top) --------
@@ -427,14 +603,12 @@ class MeshExecutor:
         shards, masked by ``filter_plan``'s result when given.  Returns
         unblocked per-group device vectors; combine with
         ``merge_counts``."""
-        primary = (field, view)
-        keys = [primary] + [k for k in self._filter_keys(filter_plan)
-                            if k != primary]
+        keys = self.batch_keys((field, view), filter_plan)
         slotted, params = (None, np.zeros(0, dtype=np.int32)) \
             if filter_plan is None else parametrize(filter_plan)
         params = jnp.asarray(params)
         parts = []
-        for shard_list, placed, sig in self._placed_groups(
+        for shard_list, placed, sig in self._stream_groups(
                 keys, holder, index, shards):
             if sig[0] is None:
                 continue  # field fragment absent everywhere in this group
@@ -468,7 +642,8 @@ class MeshExecutor:
                 fn = self._jit_shard_map(
                     key, block_fn,
                     (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
-            parts.append(fn(params, *placed_args))
+            with _DISPATCH_LOCK:
+                parts.append(fn(params, *placed_args))
         return parts
 
     def row_counts(self, field: str, view: str, filter_plan, holder,
@@ -483,14 +658,12 @@ class MeshExecutor:
         """Dispatch the per-slice popcounts; returns unblocked [2, depth+1]
         device matrices (one per shape group); combine via
         ``bsi.weighted_sum`` per part and add."""
-        primary = (field, view)
-        keys = [primary] + [k for k in self._filter_keys(filter_plan)
-                            if k != primary]
+        keys = self.batch_keys((field, view), filter_plan)
         slotted, params = (None, np.zeros(0, dtype=np.int32)) \
             if filter_plan is None else parametrize(filter_plan)
         params = jnp.asarray(params)
         parts = []
-        for shard_list, placed, sig in self._placed_groups(
+        for shard_list, placed, sig in self._stream_groups(
                 keys, holder, index, shards):
             if sig[0] is None or sig[0][0] < bsi.OFFSET_ROW + 1:
                 continue
@@ -520,7 +693,8 @@ class MeshExecutor:
                 fn = self._jit_shard_map(
                     key, block_fn,
                     (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
-            parts.append(fn(params, *placed_args))
+            with _DISPATCH_LOCK:
+                parts.append(fn(params, *placed_args))
         return parts
 
     def bsi_sum(self, field: str, view: str, filter_plan, holder,
@@ -538,14 +712,12 @@ class MeshExecutor:
                     index, shards, want_max: bool):
         """Per-shard extremum bits gathered to host; returns a list of
         (value, count) per shard (padded shards yield count 0)."""
-        primary = (field, view)
-        keys = [primary] + [k for k in self._filter_keys(filter_plan)
-                            if k != primary]
+        keys = self.batch_keys((field, view), filter_plan)
         slotted, params = (None, np.zeros(0, dtype=np.int32)) \
             if filter_plan is None else parametrize(filter_plan)
         params = jnp.asarray(params)
         out = []
-        for shard_list, placed, sig in self._placed_groups(
+        for shard_list, placed, sig in self._stream_groups(
                 keys, holder, index, shards):
             if sig[0] is None or sig[0][0] < bsi.OFFSET_ROW + 1:
                 continue
@@ -594,7 +766,9 @@ class MeshExecutor:
                     key, block_fn,
                     (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes),
                     out_specs, check_vma=check_vma)
-            bits, neg, cnt = (np.asarray(x) for x in fn(params, *placed_args))
+            with _DISPATCH_LOCK:
+                outs = fn(params, *placed_args)
+            bits, neg, cnt = (np.asarray(x) for x in outs)
             for i in range(len(shard_list)):
                 out.append(bsi.reconstruct_min_max(
                     bits[i], int(neg[i]), int(cnt[i])))
@@ -613,6 +787,9 @@ class MeshExecutor:
         keys = plan_inputs(slotted)
         params = jnp.asarray(params_mat)               # [B, P]
         parts = []
+        # no _stream_groups here: the ONLY caller (_run_batched_groups)
+        # owns the slice schedule and passes pre-scheduled shard slices —
+        # re-scheduling would re-walk the holder per (group x chunk)
         for shard_list, placed, sig in self._placed_groups(
                 keys, holder, index, shards):
             if all(s is None for s in sig):
@@ -640,18 +817,20 @@ class MeshExecutor:
                 fn = self._jit_shard_map(
                     key, block_fn,
                     (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
-            parts.append(fn(params, *[a for _, a, _ in present]))
+            with _DISPATCH_LOCK:
+                parts.append(fn(params, *[a for _, a, _ in present]))
         return parts
 
     def row_counts_batch_async(self, field: str, view: str, slotted_filter,
                                params_mat, holder, index, shards) -> list:
         """B row-count passes sharing one filter shape; parts are
         [B, rows] matrices."""
-        primary = (field, view)
-        keys = [primary] + [k for k in self._filter_keys(slotted_filter)
-                            if k != primary]
+        keys = self.batch_keys((field, view), slotted_filter)
         params = jnp.asarray(params_mat)
         parts = []
+        # no _stream_groups here: the ONLY caller (_run_batched_groups)
+        # owns the slice schedule and passes pre-scheduled shard slices —
+        # re-scheduling would re-walk the holder per (group x chunk)
         for shard_list, placed, sig in self._placed_groups(
                 keys, holder, index, shards):
             if sig[0] is None:
@@ -690,17 +869,19 @@ class MeshExecutor:
                 fn = self._jit_shard_map(
                     key, block_fn,
                     (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
-            parts.append(fn(params, *[a for _, a, _ in present]))
+            with _DISPATCH_LOCK:
+                parts.append(fn(params, *[a for _, a, _ in present]))
         return parts
 
     def bsi_sum_batch_async(self, field: str, view: str, slotted_filter,
                             params_mat, holder, index, shards) -> list:
         """B BSI sums sharing one filter shape; parts are [B, 2, depth+1]."""
-        primary = (field, view)
-        keys = [primary] + [k for k in self._filter_keys(slotted_filter)
-                            if k != primary]
+        keys = self.batch_keys((field, view), slotted_filter)
         params = jnp.asarray(params_mat)
         parts = []
+        # no _stream_groups here: the ONLY caller (_run_batched_groups)
+        # owns the slice schedule and passes pre-scheduled shard slices —
+        # re-scheduling would re-walk the holder per (group x chunk)
         for shard_list, placed, sig in self._placed_groups(
                 keys, holder, index, shards):
             if sig[0] is None or sig[0][0] < bsi.OFFSET_ROW + 1:
@@ -736,7 +917,8 @@ class MeshExecutor:
                 fn = self._jit_shard_map(
                     key, block_fn,
                     (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
-            parts.append(fn(params, *[a for _, a, _ in present]))
+            with _DISPATCH_LOCK:
+                parts.append(fn(params, *[a for _, a, _ in present]))
         return parts
 
     # -- GroupBy inner loop (executor.go:1068 executeGroupBy) --------------
@@ -788,7 +970,7 @@ class MeshExecutor:
             if filter_plan is None else parametrize(filter_plan)
         params = jnp.asarray(params)
         parts = []
-        for shard_list, placed, sig in self._placed_groups(
+        for shard_list, placed, sig in self._stream_groups(
                 keys, holder, index, shards):
             if sig[0] is None:
                 continue
@@ -848,6 +1030,99 @@ class MeshExecutor:
                 fn = self._jit_shard_map(
                     key, block_fn,
                     (P(), P()) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
-            parts.append(fn(rids, params, *placed_args))
+            with _DISPATCH_LOCK:
+                parts.append(fn(rids, params, *placed_args))
         return parts
+
+
+class _ShardSchedule:
+    """Iterable of shard slices with prefetch + pinning.
+
+    While the consumer stages and dispatches against slice k, a background
+    uploader stages slice k+1 (host dense expansion + device placement off
+    the critical path).  Both the in-use and the prefetched slices' budget
+    entries are pinned so concurrent staging cannot evict them mid-use;
+    pins release as each slice's dispatch completes (jax holds its own
+    references to enqueued computations from then on)."""
+
+    def __init__(self, mexec, holder, index, key_lists, slices):
+        self.mexec = mexec
+        self.holder = holder
+        self.index = index
+        self.key_lists = key_lists
+        self.slices = slices
+
+    @property
+    def max_slice_len(self) -> int:
+        return max((len(s) for s in self.slices), default=0)
+
+    def _stage(self, shard_slice) -> list[tuple]:
+        """Stage every key list's stack for one slice and pin the
+        entries; returns the pinned budget keys (for the iterator to
+        release after the slice's dispatch).  On a mid-stage failure
+        (device OOM, fragment closed concurrently) every pin taken so
+        far is released before re-raising — a leaked pin would shrink
+        the effective budget for the process lifetime."""
+        pinned = []
+        try:
+            for kl in self.key_lists:
+                self.mexec._placed_groups(kl, self.holder, self.index,
+                                          shard_slice)
+                skey = self.mexec._pin_stack(kl, self.index, shard_slice)
+                if skey is not None:
+                    pinned.append(skey)
+        except BaseException:
+            for k in pinned:
+                self.mexec._budget.unpin(k)
+            raise
+        return pinned
+
+    def __iter__(self):
+        if len(self.slices) <= 1:
+            yield from self.slices
+            return
+        budget = self.mexec._budget
+        pool = self.mexec._uploader_pool()
+        fut = None   # in-flight prefetch of the slice about to be served
+        pins: list = []
+        try:
+            for i, sl in enumerate(self.slices):
+                if fut is not None:
+                    # prefetch-hit means the uploader finished BEFORE the
+                    # consumer got here (checked via done() — result()
+                    # blocks, so checking afterwards would report a hit
+                    # even when streaming serialized on the upload) and
+                    # the stacks are still token-valid
+                    done = fut.done()
+                    try:
+                        pins.extend(fut.result())
+                        budget.note_prefetch(done and all(
+                            self.mexec._is_resident(kl, self.holder,
+                                                    self.index, sl)
+                            for kl in self.key_lists))
+                    except (Exception, futures.CancelledError):
+                        # CancelledError (a BaseException since 3.8):
+                        # close() cancelling queued prefetches mid-query
+                        # must degrade to inline staging, not abort
+                        budget.note_prefetch(False)
+                    fut = None
+                # cold slices stage here; prefetched ones hit the cache
+                pins.extend(self._stage(sl))
+                if i + 1 < len(self.slices):
+                    fut = pool.submit(self._stage, self.slices[i + 1])
+                yield sl
+                # the consumer dispatched against this slice between the
+                # yield and here — safe to let the budget rotate it out
+                for k in pins:
+                    budget.unpin(k)
+                pins = []
+        finally:
+            for k in pins:
+                budget.unpin(k)
+            if fut is not None:
+                try:
+                    for k in fut.result():
+                        budget.unpin(k)
+                except (Exception, futures.CancelledError):
+                    pass
 

@@ -195,9 +195,12 @@ def build_router(api: API, server=None) -> Router:
     def debug_vars(req, args):
         """expvar-style snapshot: stats + HBM budget + query-cache state,
         so perf work can attribute latency to phases (r3 verdict #10)."""
-        from ..storage.membudget import DEFAULT_BUDGET
+        from ..storage.membudget import DEFAULT_BUDGET, HOST_STAGE_BUDGET
         out = api.stats.snapshot()
+        # deviceBudget carries the streaming-pipeline counters too:
+        # uploadBytes / prefetchHits / prefetchMisses / pinnedBytes
         out["deviceBudget"] = DEFAULT_BUDGET.stats()
+        out["hostStage"] = HOST_STAGE_BUDGET.stats()
         ex = api.executor
         if ex.prepared is not None:
             out["preparedCache"] = {
@@ -319,6 +322,15 @@ class _HandlerClass(BaseHTTPRequestHandler):
     # legitimately run to hundreds of MB, hence the generous default).
     # <= 0 means unlimited, matching device-budget-mb's 0 convention.
     max_body_bytes: int = 1 << 30
+    # Optional higher — but still bounded — ceiling for /internal/
+    # routes (max-body-internal-mb): the node-to-node plane (roaring
+    # import fan-out, resize fragment copies) can legitimately ship
+    # payloads beyond the public cap.  0 (the default) inherits the
+    # public ceiling: the path prefix alone is NOT authentication, so a
+    # bigger internal ceiling is OPT-IN and belongs behind mutual TLS —
+    # an unauthenticated default exemption would re-open the
+    # memory-exhaustion hole the public cap closes.
+    max_body_bytes_internal: int = 0
 
     # request helpers
     def json(self):
@@ -344,13 +356,21 @@ class _HandlerClass(BaseHTTPRequestHandler):
             self.close_connection = True
             self._send(400, {"error": "invalid Content-Length"})
             return
-        if 0 < self.max_body_bytes < length:
+        # /internal/ routes trade the public ceiling for the (bounded)
+        # internal one — see max_body_bytes_internal above
+        # (docs/configuration.md max-body-mb)
+        limit = self.max_body_bytes
+        if limit > 0 and parsed.path.startswith("/internal/"):
+            # 0 on the internal knob = same ceiling as the public surface
+            if self.max_body_bytes_internal > 0:
+                limit = max(limit, self.max_body_bytes_internal)
+        if 0 < limit < length:
             # answer 413, then drain a bounded amount of the in-flight
             # body so the client sees the response instead of an RST
             # (closing with unread receive data resets the connection);
             # bodies beyond the drain cap close hard anyway
             self._send(413, {"error": f"request body {length} bytes "
-                             f"exceeds limit {self.max_body_bytes}"})
+                             f"exceeds limit {limit}"})
             self.close_connection = True
             remaining = min(length, 64 << 20)
             while remaining > 0:
@@ -455,7 +475,9 @@ class TrackingHTTPServer(ThreadingHTTPServer):
 
 def make_http_server(api: API, host: str = "localhost", port: int = 10101,
                      server=None, tls=None,
-                     max_body_bytes: int | None = None) -> ThreadingHTTPServer:
+                     max_body_bytes: int | None = None,
+                     max_body_bytes_internal: int | None = None,
+                     ) -> ThreadingHTTPServer:
     """``tls``: optional (certificate, key, ca_certificate|None) paths —
     serves HTTPS, requiring client certificates (mutual TLS) when a CA is
     given (reference server/tlsconfig.go, server/server.go GetTLSConfig)."""
@@ -463,6 +485,8 @@ def make_http_server(api: API, host: str = "localhost", port: int = 10101,
     attrs = {"router": router}
     if max_body_bytes is not None:
         attrs["max_body_bytes"] = max_body_bytes
+    if max_body_bytes_internal is not None:
+        attrs["max_body_bytes_internal"] = max_body_bytes_internal
     cls = type("Handler", (_HandlerClass,), attrs)
     if tls is None:
         return TrackingHTTPServer((host, port), cls)

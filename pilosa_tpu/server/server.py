@@ -37,6 +37,11 @@ class Config:
     # blocks (storage/membudget.py DeviceBudget — the syswrap map-cap
     # analog, syswrap/mmap.go:46).  0 = unlimited (accounting only).
     device_budget_mb: int = 0
+    # Host-side dense staging cache ceiling (docs/memory-budget.md):
+    # expanded fragment blocks kept on host so re-uploads after HBM
+    # eviction skip the sparse->dense expansion.  0 disables the cache,
+    # -1 = unbounded.
+    host_stage_mb: int = 4096
     # monitors / metrics (reference server/config.go metric section)
     anti_entropy_interval: float = 600.0
     metric_poll_interval: float = 60.0
@@ -58,6 +63,11 @@ class Config:
     # Generous default: bulk imports of a dense shard legitimately run
     # to hundreds of MB.
     max_body_mb: int = 1024
+    # Opt-in higher ceiling for the node-to-node /internal/ plane
+    # (roaring import fan-out, resize fragment copies); 0 (default) =
+    # same as max_body_mb.  Raise only behind mutual TLS — the path
+    # prefix is not authentication.
+    max_body_internal_mb: int = 0
     verbose: bool = False
 
     @classmethod
@@ -82,6 +92,7 @@ class Config:
             "PILOSA_TPU_MAX_ROW_ID": ("max_row_id", int),
             "PILOSA_TPU_USE_MESH": ("use_mesh", lambda s: s != "false"),
             "PILOSA_TPU_DEVICE_BUDGET_MB": ("device_budget_mb", int),
+            "PILOSA_TPU_HOST_STAGE_MB": ("host_stage_mb", int),
             "PILOSA_TPU_METRIC_SERVICE": ("metric_service", str),
             "PILOSA_TPU_METRIC_HOST": ("metric_host", str),
             "PILOSA_TPU_DIAGNOSTICS_ENDPOINT": ("diagnostics_endpoint",
@@ -94,6 +105,8 @@ class Config:
             "PILOSA_TPU_TLS_SKIP_VERIFY": (
                 "tls_skip_verify", lambda s: s == "true"),
             "PILOSA_TPU_MAX_BODY_MB": ("max_body_mb", int),
+            "PILOSA_TPU_MAX_BODY_INTERNAL_MB": ("max_body_internal_mb",
+                                                int),
         }
         for env, (attr, conv) in env_map.items():
             if env in os.environ:
@@ -117,7 +130,9 @@ class Config:
             "data-dir": "data_dir", "bind": "bind", "max-op-n": "max_op_n",
             "max-row-id": "max_row_id", "use-mesh": "use_mesh",
             "device-budget-mb": "device_budget_mb",
+            "host-stage-mb": "host_stage_mb",
             "max-body-mb": "max_body_mb",
+            "max-body-internal-mb": "max_body_internal_mb",
         }
         for key, attr in mapping.items():
             if key in doc:
@@ -151,10 +166,15 @@ class Server:
         # The budget is process-wide; the most recent Server's config wins
         # (0 restores unlimited — a stale limit from an earlier instance in
         # the same process must not outlive its config).
-        from ..storage.membudget import DEFAULT_BUDGET
+        from ..storage.membudget import DEFAULT_BUDGET, HOST_STAGE_BUDGET
         DEFAULT_BUDGET.limit_bytes = (
             self.config.device_budget_mb * (1 << 20)
             if self.config.device_budget_mb > 0 else None)
+        HOST_STAGE_BUDGET.limit_bytes = (
+            self.config.host_stage_mb * (1 << 20)
+            if self.config.host_stage_mb > 0
+            else (0 if self.config.host_stage_mb == 0 else None))
+        HOST_STAGE_BUDGET.shrink_to_limit()
         data_dir = os.path.expanduser(self.config.data_dir)
         self.holder = Holder(
             data_dir, max_op_n=self.config.max_op_n,
@@ -188,7 +208,8 @@ class Server:
                     self.config.tls_skip_verify)
         self.httpd = make_http_server(
             self.api, host, port, server=self, tls=tls,
-            max_body_bytes=self.config.max_body_mb << 20)
+            max_body_bytes=self.config.max_body_mb << 20,
+            max_body_bytes_internal=self.config.max_body_internal_mb << 20)
         from ..utils.diagnostics import DiagnosticsCollector
         self.diagnostics = DiagnosticsCollector(
             self, self.config.diagnostics_endpoint,
@@ -260,9 +281,20 @@ class Server:
             self.stats.gauge(f"runtime.gc_pause_ms_gen{gen}",
                              round(snap["pause_s"][gen] * 1e3, 3))
         self.stats.gauge("runtime.gc_collected", snap["collected"])
-        from ..storage.membudget import DEFAULT_BUDGET
+        from ..storage.membudget import DEFAULT_BUDGET, HOST_STAGE_BUDGET
         self.stats.gauge("runtime.hbm_resident_bytes",
                          DEFAULT_BUDGET.resident_bytes)
+        # streaming-pipeline counters (docs/memory-budget.md): upload
+        # volume, prefetch effectiveness, pin pressure, host staging
+        b = DEFAULT_BUDGET.stats()
+        self.stats.gauge("runtime.hbm_upload_bytes", b["uploadBytes"])
+        self.stats.gauge("runtime.hbm_evictions", b["evictions"])
+        self.stats.gauge("runtime.hbm_prefetch_hits", b["prefetchHits"])
+        self.stats.gauge("runtime.hbm_prefetch_misses",
+                         b["prefetchMisses"])
+        self.stats.gauge("runtime.hbm_pinned_bytes", b["pinnedBytes"])
+        self.stats.gauge("runtime.host_stage_bytes",
+                         HOST_STAGE_BUDGET.resident_bytes)
 
     def _monitor_runtime(self):
         while not self._closing.wait(self.config.metric_poll_interval):

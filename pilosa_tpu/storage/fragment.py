@@ -51,7 +51,7 @@ from ..core import (
     SHARD_WORDS,
 )
 from ..ops import bitset, bsi
-from .membudget import DEFAULT_BUDGET
+from .membudget import DEFAULT_BUDGET, HOST_STAGE_BUDGET
 
 # On-disk snapshot formats.
 # v2 (magic PTPUFRG2): header then nnz LE (flat u32, word u32) interleaved
@@ -134,6 +134,9 @@ class Fragment:
         # mirrors alive (and a recreated fragment can never alias a stale
         # cache entry).
         self.gen = next(self._GEN)
+        # host-side dense staging cache: (gen, dense block) — see
+        # staged_dense()
+        self._stage = None
         self._device_dirty = True
         self._op_n = 0
         self._dirty_data = False  # mutated since last snapshot?
@@ -239,6 +242,7 @@ class Fragment:
                 self._wal_file.close()
                 self._wal_file = None
             self._drop_mirrors()
+            self._drop_stage()
 
     def snapshot(self):
         """Rewrite the snapshot file and truncate the WAL
@@ -636,6 +640,41 @@ class Fragment:
         access — do not use on hot paths."""
         return self.to_dense()
 
+    def staged_dense(self) -> np.ndarray:
+        """Dense block via the host staging cache.  After an HBM eviction
+        the re-upload reads this cached expansion instead of re-running
+        the sparse->dense scatter — under budget pressure the expansion,
+        not the transfer, dominates cold re-stages.  Keyed by the data
+        generation (any mutation invalidates); HOST_STAGE_BUDGET bounds
+        total cached host bytes LRU-wise (limit 0 disables caching).
+        With no device-budget limit nothing is ever evicted, so there is
+        no re-upload to accelerate — caching would only grow host RSS —
+        and the expansion stays transient like to_dense().
+
+        The returned array is SHARED — callers must treat it read-only
+        (device uploads and stacked-block fills copy out of it)."""
+        if HOST_STAGE_BUDGET.limit_bytes == 0 or \
+                self.budget.limit_bytes is None:
+            return self.to_dense()
+        with self._lock:
+            st = self._stage
+            if st is not None and st[0] == self.gen:
+                HOST_STAGE_BUDGET.touch(("stage", id(self)))
+                return st[1]
+            dense = self.to_dense()
+            self._stage = (self.gen, dense)
+            HOST_STAGE_BUDGET.register(("stage", id(self)), dense.nbytes,
+                                       self._evict_stage)
+            return dense
+
+    def _evict_stage(self):
+        # host-stage budget callback: drop the cached expansion only
+        self._stage = None
+
+    def _drop_stage(self):
+        HOST_STAGE_BUDGET.unregister(("stage", id(self)))
+        self._stage = None
+
     def device(self, target=None):
         """The HBM-resident mirror (uploads if stale).  This is the query
         hot path's input — equivalent to the mmap'd storage the reference
@@ -662,7 +701,7 @@ class Fragment:
             mirror = self._mirrors.get(target)
             key = (id(self), target)
             if mirror is None:
-                mirror = jax.device_put(self.to_dense(), target)
+                mirror = jax.device_put(self.staged_dense(), target)
                 self._mirrors[target] = mirror
                 self.budget.register(
                     key, self._cap_rows * SHARD_WORDS * 4,

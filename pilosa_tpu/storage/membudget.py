@@ -1,4 +1,5 @@
-"""Device-memory budget: LRU accounting of device-resident bytes.
+"""Device-memory budget: LRU accounting of device-resident bytes, with
+pinning for in-flight work.
 
 The reference's memory story is mmap + the OS page cache (fragments are
 lazily paged, syswrap caps map counts — syswrap/mmap.go:46, fragment.go:311).
@@ -9,9 +10,18 @@ configurable budget and evicts the least-recently-used entries (dropping
 the owner's reference so the buffer frees) when a new allocation would
 exceed it.
 
+Entries referenced by an in-flight plan or a prefetch in progress are
+PINNED: eviction skips them (preferring the unpinned-coldest) and a fully
+pinned budget admits the incoming entry over-limit rather than dropping a
+buffer out from under a dispatch.  The budget also keeps streaming
+counters — cumulative upload bytes, prefetch hits/misses, evictions —
+surfaced through ``stats()`` at /debug/vars and the runtime gauges.
+
 One process-wide default budget keeps wiring simple (Server config
 ``device_budget_mb`` / PILOSA_TPU_DEVICE_BUDGET_MB sets it); tests construct
-private instances.
+private instances.  ``HOST_STAGE_BUDGET`` is a second instance bounding the
+HOST-side dense staging cache (storage/fragment.py staged_dense) with the
+same LRU machinery — there "upload bytes" counts staged host bytes.
 """
 
 from __future__ import annotations
@@ -24,11 +34,18 @@ from typing import Callable
 class DeviceBudget:
     def __init__(self, limit_bytes: int | None = None):
         self.limit_bytes = limit_bytes  # None = unlimited (accounting only)
-        self._entries: OrderedDict[tuple, tuple[int, Callable[[], None]]] = \
-            OrderedDict()
+        # key -> [nbytes, evict callback, pin count]
+        self._entries: OrderedDict[tuple, list] = OrderedDict()
         self._total = 0
         self._peak = 0
         self.evictions = 0
+        # streaming pipeline counters (parallel/mesh_exec.py): bytes
+        # (re-)registered = bytes shipped to the device, and whether a
+        # scheduled slice's prefetch completed before the consumer
+        # reached it
+        self.upload_bytes = 0
+        self.prefetch_hits = 0
+        self.prefetch_misses = 0
         self._lock = threading.RLock()
 
     @property
@@ -39,15 +56,27 @@ class DeviceBudget:
         """Pop LRU entries until ``incoming`` more bytes fit the limit;
         returns their callbacks for the caller to run OUTSIDE the lock
         (owners may take their own locks without ordering against this
-        one).  Caller must hold self._lock."""
+        one).  Caller must hold self._lock.
+
+        Pinned entries are NEVER popped — an in-flight dispatch or a
+        prefetch holds them — so eviction takes the unpinned-coldest;
+        when everything left is pinned, the budget runs transiently
+        over-limit instead of corrupting in-flight work."""
         to_evict: list[Callable[[], None]] = []
-        if self.limit_bytes is not None:
-            while self._entries and \
-                    self._total + incoming > self.limit_bytes:
-                _, (freed, cb) = self._entries.popitem(last=False)
-                self._total -= freed
-                self.evictions += 1
-                to_evict.append(cb)
+        if self.limit_bytes is None:
+            return to_evict
+        while self._entries and self._total + incoming > self.limit_bytes:
+            victim = None
+            for key, e in self._entries.items():  # LRU -> MRU order
+                if e[2] == 0:
+                    victim = key
+                    break
+            if victim is None:
+                break  # all pinned: admit over-limit
+            freed, cb, _ = self._entries.pop(victim)
+            self._total -= freed
+            self.evictions += 1
+            to_evict.append(cb)
         return to_evict
 
     @staticmethod
@@ -61,15 +90,20 @@ class DeviceBudget:
     def register(self, key: tuple, nbytes: int, evict: Callable[[], None]):
         """Account ``nbytes`` under ``key``; ``evict`` drops the owner's
         reference when called.  Evicts LRU entries first if needed (never
-        evicting the incoming entry itself)."""
+        evicting the incoming entry itself).  Re-registering an existing
+        key keeps its pin count (the owner re-staged data an in-flight
+        user still holds pinned)."""
         with self._lock:
             old = self._entries.pop(key, None)
+            pins = 0
             if old is not None:
                 self._total -= old[0]
+                pins = old[2]
             to_evict = self._evict_lru_locked(nbytes)
-            self._entries[key] = (nbytes, evict)
+            self._entries[key] = [nbytes, evict, pins]
             self._total += nbytes
             self._peak = max(self._peak, self._total)
+            self.upload_bytes += nbytes
         self._run_evictions(to_evict)
 
     def reset_peak(self):
@@ -92,6 +126,35 @@ class DeviceBudget:
             if key in self._entries:
                 self._entries.move_to_end(key)
 
+    def pin(self, key: tuple) -> bool:
+        """Mark ``key`` in use by an in-flight plan or prefetch: eviction
+        will not pop it until every pin is released.  Returns False (and
+        pins nothing) when the key is not registered — callers proceed
+        unprotected; correctness is unaffected because jax keeps device
+        buffers alive for enqueued computations, pinning only prevents a
+        wasteful re-stage."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return False
+            e[2] += 1
+            return True
+
+    def unpin(self, key: tuple):
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e[2] > 0:
+                e[2] -= 1
+
+    def note_prefetch(self, hit: bool):
+        """Record whether a scheduled slice was already staged when the
+        consumer reached it (parallel/mesh_exec.py streaming)."""
+        with self._lock:
+            if hit:
+                self.prefetch_hits += 1
+            else:
+                self.prefetch_misses += 1
+
     def unregister(self, key: tuple):
         with self._lock:
             e = self._entries.pop(key, None)
@@ -100,14 +163,28 @@ class DeviceBudget:
 
     def stats(self) -> dict:
         with self._lock:
+            pinned_bytes = sum(e[0] for e in self._entries.values()
+                               if e[2] > 0)
             return {
                 "residentBytes": self._total,
                 "peakBytes": self._peak,
                 "limitBytes": self.limit_bytes,
                 "entries": len(self._entries),
                 "evictions": self.evictions,
+                "uploadBytes": self.upload_bytes,
+                "prefetchHits": self.prefetch_hits,
+                "prefetchMisses": self.prefetch_misses,
+                "pinnedBytes": pinned_bytes,
             }
 
 
 # Process-wide default (accounting-only until a limit is configured).
 DEFAULT_BUDGET = DeviceBudget()
+
+# Host-side dense staging cache budget (fragment.staged_dense): bounds the
+# expanded dense blocks kept around so a re-upload after HBM eviction
+# skips the sparse->dense expansion.  limit 0 = staging disabled (every
+# upload re-expands), None = unbounded.  Server config ``host_stage_mb``
+# sets it; 4 GiB default keeps steady-state re-uploads at transfer speed
+# without letting staging rival the sparse store for host memory.
+HOST_STAGE_BUDGET = DeviceBudget(limit_bytes=4 << 30)

@@ -24,6 +24,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: seconds-to-minutes end-to-end exercises (bench smoke, "
+        "multihost) excluded from tier-1 via -m 'not slow'")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

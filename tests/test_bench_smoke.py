@@ -1,0 +1,28 @@
+"""bench.py --smoke as a slow-marked pytest: the resident AND the
+budgeted/streaming paths run end-to-end (tiny shard counts, seconds) so
+the shard-streaming pipeline stays covered without bloating tier-1."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_smoke_resident_and_budgeted():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    data = json.loads(out.stdout.strip().splitlines()[-1])
+    # the smoke asserts answer-identity internally; re-check the pipeline
+    # engagement signals it publishes
+    assert data["smoke"] is True
+    assert data["evictions"] > 0
+    assert data["prefetch_hits"] + data["prefetch_misses"] > 0
+    assert data["pinned_bytes"] == 0  # all pins released

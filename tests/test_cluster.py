@@ -881,3 +881,38 @@ def test_pooled_conn_idle_replacement(cluster3, monkeypatch):
     status, _ = client._request(host, "GET", "/status")
     assert status == 200
     assert client._local.conns[host] is second
+
+
+def test_resize_complete_prunes_lost_shards_everywhere():
+    """Data-loss shards ride the resize-complete broadcast so EVERY
+    node's availability maps drop them (r5 advisor: coordinator-only
+    pruning let peer polls re-propagate forgotten shards forever)."""
+    from pilosa_tpu.parallel.cluster import Cluster
+    from pilosa_tpu.storage import Holder
+
+    h = Holder(None)
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    f.remote_available_shards.update({2, 3, 9})
+    c = Cluster("node0", ["localhost:1", "localhost:2"], holder=h)
+    c.cleaner_grace = 0
+    c._remote_shards["i"] = {1, 2, 3}
+    c.handle_message({
+        "type": "resize-complete", "epoch": 1, "replicaN": 1,
+        "membership": [{"id": "node0", "uri": "localhost:1"},
+                       {"id": "node1", "uri": "localhost:2"}],
+        "lostShards": {"i": [2, 3], "ghost": [7]}})
+    assert c._remote_shards["i"] == {1}
+    assert f.remote_available_shards == {9}
+    assert c.epoch == 1
+    # the prune runs on FIRST application only: shard 2 re-imported
+    # after the resize must survive a re-driven duplicate (same epoch)
+    # and a stale older-epoch message alike
+    for dup_epoch in (1, 0):
+        c._remote_shards["i"] = {1, 2}
+        c.handle_message({
+            "type": "resize-complete", "epoch": dup_epoch, "replicaN": 1,
+            "membership": [{"id": "node0", "uri": "localhost:1"},
+                           {"id": "node1", "uri": "localhost:2"}],
+            "lostShards": {"i": [2, 3]}})
+        assert c._remote_shards["i"] == {1, 2}, dup_epoch

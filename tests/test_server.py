@@ -360,7 +360,7 @@ def test_body_size_limit(tmp_path):
     import http.client
 
     cfg = Config(data_dir=str(tmp_path / "bl"), bind="localhost:0",
-                 max_body_mb=1)
+                 max_body_mb=1, max_body_internal_mb=4)
     s = Server(cfg)
     s.open()
     try:
@@ -387,6 +387,17 @@ def test_body_size_limit(tmp_path):
         conn.close()
         # a normal-size request still works
         assert call(s, "POST", "/index/big", {}) == {}
+        # the INTERNAL plane gets its own OPT-IN ceiling (roaring import
+        # fan-out and resize fragment copies can exceed the public cap):
+        # a body over the public limit but under max-body-internal-mb is
+        # read and routed (404 here — no cluster routes registered),
+        # while one over the internal ceiling still gets 413
+        code, err = call_err(s, "POST", "/internal/bogus",
+                             b"x" * ((1 << 20) + 1))
+        assert code == 404 and "exceeds limit" not in err["error"]
+        code, err = call_err(s, "POST", "/internal/bogus",
+                             b"x" * ((4 << 20) + 1))
+        assert code == 413 and "exceeds limit" in err["error"]
     finally:
         s.close()
 
