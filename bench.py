@@ -703,13 +703,80 @@ def _smoke_norm(results):
     return [[(p.id, p.count) for p in r] for r in results]
 
 
+def run_overload_smoke() -> dict:
+    """Overload-armor leg of --smoke (docs/robustness.md): drive the
+    REAL server's admission and deadline paths so a regression in either
+    shows in the bench trajectory.  A burst of 4x max-queries against a
+    slot pool of 2 must yield only 200s/503s with both present, and a
+    failpoint-delayed query under a 50 ms budget must 504 — asserted,
+    then reported."""
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from pilosa_tpu.server.server import Config, Server
+    from pilosa_tpu.utils.faults import FAULTS
+
+    srv = Server(Config(data_dir=tempfile.mkdtemp(prefix="ptpu_smoke_"),
+                        bind="localhost:0", anti_entropy_interval=0,
+                        max_queries=2, queue_timeout=0.05))
+    try:
+        def post(path, body):
+            req = urllib.request.Request(
+                f"http://localhost:{srv.port}{path}", method="POST",
+                data=body.encode())
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    resp.read()
+                    return resp.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code
+
+        srv.open()
+        post("/index/sm", "{}")
+        post("/index/sm/field/f", "{}")
+        post("/index/sm/query", "Set(1, f=1) Set(1048579, f=1)")
+        FAULTS.arm("mesh.slice", mode="delay", arg=0.15, match="sm")
+        try:
+            codes = []
+            lock = threading.Lock()
+
+            def one():
+                c = post("/index/sm/query", "Count(Row(f=1))")
+                with lock:
+                    codes.append(c)
+
+            threads = [threading.Thread(target=one) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert set(codes) <= {200, 503}, f"burst statuses {set(codes)}"
+            assert codes.count(200) >= 1 and codes.count(503) >= 1, codes
+            t0 = time.perf_counter()
+            code_504 = post("/index/sm/query?timeout=0.05",
+                            "Count(Row(f=1))")
+            deadline_s = time.perf_counter() - t0
+            assert code_504 == 504, f"expected 504, got {code_504}"
+        finally:
+            FAULTS.disarm()
+        return {"burst_200": codes.count(200),
+                "burst_503": codes.count(503),
+                "deadline_504_s": round(deadline_s, 3)}
+    finally:
+        srv.close()
+
+
 def run_smoke():
     """--smoke: seconds-scale end-to-end exercise of the resident AND the
     budgeted/streaming query paths on tiny shard counts — wired as a
     slow-marked pytest (tests/test_bench_smoke.py) so the streaming
     pipeline is covered without bloating tier-1.  Asserts budgeted
     results are identical to the resident run and that eviction,
-    streaming, and prefetch actually engaged; prints one JSON line."""
+    streaming, and prefetch actually engaged; also drives the admission/
+    deadline overload path (run_overload_smoke); prints one JSON line."""
     from pilosa_tpu.executor import Executor
     from pilosa_tpu.storage.membudget import DEFAULT_BUDGET
 
@@ -768,6 +835,7 @@ def run_smoke():
     finally:
         DEFAULT_BUDGET.limit_bytes = old_limit
         ex5.close()
+    out["overload"] = run_overload_smoke()
     out["total_s"] = round(time.perf_counter() - t_start, 2)
     print(json.dumps(out))
 

@@ -89,16 +89,24 @@ class API:
 
     # -- query (api.go:135 Query) ------------------------------------------
 
-    def query(self, index: str, query: str, shards=None) -> list[Any]:
+    def query(self, index: str, query: str, shards=None,
+              ctx=None) -> list[Any]:
+        """``ctx``: optional QueryContext carrying the query's deadline
+        (utils/deadline.py); defaults to the caller's active context (the
+        HTTP handler installs one from ?timeout= / the deadline header /
+        the query-timeout config default)."""
         self._validate("Query")
         if self.stats:
             self.stats.count("query", 1)
+        from .utils.deadline import current
+        if ctx is None:
+            ctx = current()
         from .utils.tracing import GLOBAL_TRACER
         with GLOBAL_TRACER.span("api.Query") as span:
             span.set_tag("index", index)
             if self.cluster is not None:
-                return self.cluster.execute(index, query, shards)
-            return self.executor.execute(index, query, shards)
+                return self.cluster.execute(index, query, shards, ctx=ctx)
+            return self.executor.execute(index, query, shards, ctx=ctx)
 
     # -- DDL ---------------------------------------------------------------
 

@@ -217,6 +217,12 @@ data-dir = "~/.pilosa_tpu"
 bind = "localhost:10101"
 max-op-n = 10000
 # max-body-mb = 1024
+# overload armor (docs/robustness.md)
+# query-timeout = 0        # default per-query deadline seconds, 0 = off
+# max-queries = 64         # concurrent-query slots (public + internal)
+# queue-timeout = 0.5      # seconds to wait for a slot before 503
+# breaker-threshold = 5    # consecutive peer failures -> circuit open
+# drain-seconds = 5        # graceful-drain budget on shutdown
 
 [cluster]
 # hosts = ["localhost:10101", "localhost:10102"]
@@ -247,6 +253,12 @@ def cmd_config(args) -> int:
     print(f"use-mesh = {str(cfg.use_mesh).lower()}")
     print(f"device-budget-mb = {cfg.device_budget_mb}")
     print(f"max-body-mb = {cfg.max_body_mb}")
+    print(f"query-timeout = {cfg.query_timeout}")
+    print(f"max-queries = {cfg.max_queries}")
+    print(f"queue-timeout = {cfg.queue_timeout}")
+    print(f"breaker-threshold = {cfg.breaker_threshold}")
+    print(f"drain-seconds = {cfg.drain_seconds}")
+    print(f"health-down-threshold = {cfg.health_down_threshold}")
     print()
     print("[cluster]")
     print(f"hosts = [{', '.join(q(h) for h in cfg.cluster_hosts)}]")

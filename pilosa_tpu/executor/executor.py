@@ -323,10 +323,26 @@ class Executor:
     # -- entry point (executor.go:113 Execute) -----------------------------
 
     def execute(self, index_name: str, query, shards=None,
-                translate: bool = True) -> list[Any]:
+                translate: bool = True, ctx=None) -> list[Any]:
         """``translate=False`` for internal (already-translated) requests —
         the reference's opt.Remote skipping translateCalls
-        (executor.go:147)."""
+        (executor.go:147).
+
+        ``ctx``: optional QueryContext (utils/deadline.py).  Defaults to
+        the caller's active context; installed as current for the whole
+        execution so the mesh shard-slice loops can abort an expired
+        query between slices, and checked here between per-call
+        dispatches and before the blocking fetch."""
+        from ..utils.deadline import activate, check_current, current
+        if ctx is None:
+            ctx = current()
+        with activate(ctx):
+            return self._execute_ctx(index_name, query, shards, translate,
+                                     check_current)
+
+    def _execute_ctx(self, index_name: str, query, shards, translate,
+                     check_current) -> list[Any]:
+        check_current("execute")
         stats = self.stats
         if isinstance(query, str):
             if translate and self.prepared is not None:
@@ -362,8 +378,12 @@ class Executor:
                 results = self._execute_calls_grouped(index_name,
                                                       query.calls, shards)
             else:
-                results = [self._execute_call(index_name, c, shards)
-                           for c in query.calls]
+                results = []
+                for c in query.calls:
+                    check_current("call dispatch")
+                    results.append(self._execute_call(index_name, c,
+                                                      shards))
+        check_current("result fetch")
         with stats.timer("query.fetch"):
             results = _resolve_pendings(results)
         if translate and self.translator.needs_translation(index_name):

@@ -50,6 +50,8 @@ from ..executor.results import (
 )
 from ..pql import Call, Query, parse
 from ..pql.wire import call_from_wire, call_to_wire
+from ..utils.deadline import DEADLINE_HEADER, current as current_ctx
+from ..utils.faults import FAULTS
 from .placement import Placement
 
 NODE_READY = "READY"
@@ -63,6 +65,15 @@ STATE_RESIZING = "RESIZING"
 
 class ClusterError(RuntimeError):
     pass
+
+
+class CircuitOpenError(ClusterError):
+    """Fail-fast rejection: the target peer's circuit breaker is open
+    (N consecutive transport failures).  A ClusterError subclass so
+    callers that only know ClusterError still handle it, but DISTINCT so
+    the fan-out treats it like a transport failure (exclude + replica
+    retry + mark DOWN) rather than an application error from a live
+    peer."""
 
 
 # -- result wire codec ------------------------------------------------------
@@ -123,10 +134,38 @@ def result_from_wire(d: dict):
 
 # -- internal RPC client ----------------------------------------------------
 
+class _Breaker:
+    """Per-peer circuit breaker state (closed -> open -> half-open)."""
+
+    __slots__ = ("fails", "state", "opened_at", "trial_inflight",
+                 "opened_total", "fast_fails")
+
+    def __init__(self):
+        self.fails = 0
+        self.state = "closed"
+        self.opened_at = 0.0
+        self.trial_inflight = False
+        self.opened_total = 0
+        self.fast_fails = 0
+
+
 class InternalClient:
     """Node-to-node HTTP(S) RPC (reference http/client.go:69
     InternalClient).  Hosts may carry an ``https://`` prefix; mutual-TLS
-    client credentials come from ``configure_tls``."""
+    client credentials come from ``configure_tls``.
+
+    Every request runs through a PER-PEER circuit breaker:
+    ``breaker_threshold`` consecutive TRANSPORT failures (timeouts,
+    refused/reset connections — HTTP error statuses are a live peer and
+    do not count) open the circuit, and further requests fail fast with
+    ``CircuitOpenError`` instead of each burning a full socket timeout
+    against a dead node.  After ``breaker_cooldown`` seconds ONE trial
+    request is let through (half-open); success closes the circuit,
+    failure re-arms the cooldown.  ``Cluster.probe_peers`` runs on the
+    health cadence and its /status probes double as the half-open
+    trials, so breaker state and NODE_DOWN converge on the same answer
+    (cluster.go:1724 confirmNodeDown).  ``breaker_threshold <= 0``
+    disables breaking entirely."""
 
     # Pooled connections idle longer than this are proactively replaced:
     # servers close idle keep-alives after 120 s (handler timeout), and a
@@ -136,8 +175,12 @@ class InternalClient:
     # the narrow retry policy sound.
     POOL_IDLE_MAX = 60.0
 
-    def __init__(self, timeout: float = 30.0):
+    def __init__(self, timeout: float = 30.0, breaker_threshold: int = 5,
+                 breaker_cooldown: float = 5.0, stats=None):
         self.timeout = timeout
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown = breaker_cooldown
+        self.stats = stats
         self._ssl_ctx = None
         # per-thread keep-alive connections (the server speaks HTTP/1.1):
         # a cluster fan-out must not pay a TCP handshake per sub-query
@@ -146,6 +189,104 @@ class InternalClient:
         # release sockets owned by other threads' pools
         self._all_conns: set = set()
         self._conns_lock = threading.Lock()
+        self._breakers: dict[str, _Breaker] = {}
+        self._breaker_lock = threading.Lock()
+        # per-host pool generation (see note_recovered); conns stamp the
+        # generation at creation and are lazily discarded on mismatch
+        self._host_gen: dict[str, int] = {}
+
+    def note_recovered(self, host: str):
+        """A peer that was DOWN is reachable again: every pooled
+        connection to it predates the outage and points at a dead (or
+        restarted) process.  Reusing one is worse than useless — the
+        send can land in the severed socket's kernel buffer and fail
+        only at getresponse(), exactly where non-idempotent POSTs must
+        NOT be retried, turning the peer's recovery into spurious write
+        failures.  Bumping the host's pool generation makes every
+        thread lazily discard its stale conn and dial fresh (GIL-atomic
+        int bump; racing requests see either generation, both safe)."""
+        self._host_gen[host] = self._host_gen.get(host, 0) + 1
+
+    # -- circuit breaker ---------------------------------------------------
+
+    def _breaker(self, host: str) -> _Breaker:
+        b = self._breakers.get(host)
+        if b is None:
+            # insert under the lock: breaker_snapshot iterates the dict
+            # under it, and an unlocked insert resizing the dict mid-
+            # iteration would 500 the /debug/vars endpoint
+            with self._breaker_lock:
+                b = self._breakers.setdefault(host, _Breaker())
+        return b
+
+    def _breaker_allow(self, host: str, trial: bool = False):
+        """Admit the request or raise CircuitOpenError.  When the circuit
+        is open and the cooldown has elapsed, admit exactly ONE trial
+        (half-open) — concurrent callers keep failing fast until the
+        trial resolves.  ``trial=True`` (health probes) is ALWAYS
+        admitted as the half-open trial regardless of cooldown: probes
+        are the designated recovery path, and a dead node's own failed
+        probes re-arm the cooldown every cycle — gating the probe on it
+        would let the breaker latch a RECOVERED node DOWN forever."""
+        if self.breaker_threshold <= 0:
+            return
+        b = self._breaker(host)
+        with self._breaker_lock:
+            if b.state == "closed":
+                return
+            now = time.monotonic()
+            if trial or (now - b.opened_at >= self.breaker_cooldown
+                         and not b.trial_inflight):
+                b.trial_inflight = True  # half-open trial
+                return
+            b.fast_fails += 1
+            if self.stats is not None:
+                self.stats.count("breaker.fail_fast")
+        raise CircuitOpenError(
+            f"circuit open for {host} ({b.fails} consecutive failures); "
+            f"failing fast")
+
+    def _breaker_success(self, host: str):
+        if self.breaker_threshold <= 0:
+            return
+        b = self._breaker(host)
+        # lock-free fast path for the overwhelmingly common steady state:
+        # every fan-out RPC success would otherwise serialize on the one
+        # process-wide breaker lock just to rewrite values it already
+        # has.  Racing a concurrent failure here is benign — both fields
+        # only move toward this state on success, and a missed reset
+        # costs at most one extra failure toward the threshold.
+        if b.state == "closed" and b.fails == 0:
+            return
+        with self._breaker_lock:
+            b.fails = 0
+            b.trial_inflight = False
+            b.state = "closed"
+
+    def _breaker_failure(self, host: str):
+        if self.breaker_threshold <= 0:
+            return
+        b = self._breaker(host)
+        with self._breaker_lock:
+            b.trial_inflight = False
+            b.fails += 1
+            now = time.monotonic()
+            if b.state == "open":
+                b.opened_at = now  # failed trial re-arms the cooldown
+            elif b.fails >= self.breaker_threshold:
+                b.state = "open"
+                b.opened_at = now
+                b.opened_total += 1
+                if self.stats is not None:
+                    self.stats.count("breaker.opened")
+
+    def breaker_snapshot(self) -> dict:
+        """Per-peer breaker state for /debug/vars."""
+        with self._breaker_lock:
+            return {host: {"state": b.state, "consecutiveFails": b.fails,
+                           "openedTotal": b.opened_total,
+                           "fastFails": b.fast_fails}
+                    for host, b in self._breakers.items()}
 
     def close(self):
         with self._conns_lock:
@@ -187,13 +328,39 @@ class InternalClient:
     def _request(self, host: str, method: str, path: str,
                  body: bytes | None = None,
                  ctype: str = "application/json",
-                 timeout: float | None = None) -> tuple[int, bytes]:
+                 timeout: float | None = None,
+                 headers_extra: dict | None = None,
+                 breaker_trial: bool = False) -> tuple[int, bytes]:
+        """Breaker-gated request: open circuit -> CircuitOpenError fast;
+        transport failures (OSError/HTTPException, including injected
+        faults) count toward opening it, HTTP error statuses do not.
+        ``breaker_trial``: health probes — always admitted (see
+        _breaker_allow)."""
+        self._breaker_allow(host, trial=breaker_trial)
+        try:
+            out = self._request_inner(host, method, path, body, ctype,
+                                      timeout, headers_extra)
+        except (OSError, http.client.HTTPException):
+            self._breaker_failure(host)
+            raise
+        self._breaker_success(host)
+        return out
+
+    def _request_inner(self, host: str, method: str, path: str,
+                       body: bytes | None = None,
+                       ctype: str = "application/json",
+                       timeout: float | None = None,
+                       headers_extra: dict | None = None
+                       ) -> tuple[int, bytes]:
+        FAULTS.hit("client.request", key=f"{host} {path}")
         timeout = timeout or self.timeout
         conns = getattr(self._local, "conns", None)
         if conns is None:
             conns = self._local.conns = {}
         headers = {"Content-Type": ctype,
                    "Content-Length": str(len(body or b""))}
+        if headers_extra:
+            headers.update(headers_extra)
 
         def drop(conn):
             conn.close()
@@ -207,8 +374,16 @@ class InternalClient:
         # retry (it would double every timeout against a dead node), and
         # a response-phase failure must not retry (the peer may have
         # executed a non-idempotent request already).
+        host_gen = self._host_gen.get(host, 0)
         for attempt in (0, 1):
             conn = conns.get(host)
+            # a conn pooled before the peer's last recovery points at the
+            # DEAD pre-restart process (see note_recovered): discard it
+            # rather than risk a response-phase failure on a POST
+            if conn is not None and \
+                    getattr(conn, "_ptpu_gen", 0) != host_gen:
+                drop(conn)
+                conn = None
             # a pooled entry whose socket is gone (client.close() raced a
             # fan-out thread) is NOT a live keep-alive: replace it so it
             # re-registers and gets fresh-connection (no-retry) semantics
@@ -223,6 +398,7 @@ class InternalClient:
                 if conn is not None:
                     drop(conn)
                 conn = conns[host] = self._new_conn(host, timeout)
+                conn._ptpu_gen = host_gen
                 with self._conns_lock:
                     self._all_conns.add(conn)
             if conn.sock is not None:
@@ -252,10 +428,12 @@ class InternalClient:
                 conn._ptpu_last_use = time.monotonic()
             return resp.status, data
 
-    def _json(self, host, method, path, obj=None, timeout=None):
+    def _json(self, host, method, path, obj=None, timeout=None,
+              headers=None, breaker_trial=False):
         body = None if obj is None else json.dumps(obj).encode()
         status, data = self._request(host, method, path, body,
-                                     timeout=timeout)
+                                     timeout=timeout, headers_extra=headers,
+                                     breaker_trial=breaker_trial)
         if status >= 400:
             try:
                 msg = json.loads(data).get("error", data.decode())
@@ -266,8 +444,29 @@ class InternalClient:
 
     # -- RPCs --------------------------------------------------------------
 
-    def status(self, host: str) -> dict:
-        return self._json(host, "GET", "/status")
+    def status(self, host: str, timeout: float | None = None,
+               probe: bool = False) -> dict:
+        """``probe=True``: this is a health probe — it rides through an
+        open breaker as the half-open trial (the designated recovery
+        path; see _breaker_allow)."""
+        return self._json(host, "GET", "/status", timeout=timeout,
+                          breaker_trial=probe)
+
+    @staticmethod
+    def _deadline_extras(deadline_s, base_timeout):
+        """(headers, timeout) for a deadline-carrying hop: the header
+        ships the coordinator's REMAINING budget so the remote inherits
+        it, and the socket timeout is clamped just above that budget so
+        a hung peer costs ~the budget, not the full default timeout (a
+        small grace lets the remote's own 504 arrive instead of being
+        cut off mid-response)."""
+        if deadline_s is None:
+            return None, None
+        deadline_s = max(deadline_s, 0.001)
+        headers = {DEADLINE_HEADER: f"{deadline_s:.6f}"}
+        timeout = min(base_timeout,
+                      deadline_s + max(0.05, 0.5 * deadline_s))
+        return headers, timeout
 
     def query_call(self, host: str, index: str, call: Call,
                    shards: list[int] | None) -> Any:
@@ -279,15 +478,22 @@ class InternalClient:
         return result_from_wire(out["result"])
 
     def query_calls(self, host: str, index: str, calls: list[Call],
-                    shards: list[int] | None) -> tuple[list[Any], float]:
+                    shards: list[int] | None,
+                    deadline_s: float | None = None
+                    ) -> tuple[list[Any], float]:
         """Pinned MULTI-call query: the peer executes the whole batch as
         one device wave (its executor's grouped/prepared path) instead of
         one dispatch per call.  Returns (results, peer_exec_seconds) so
-        the coordinator can attribute wire vs device time."""
+        the coordinator can attribute wire vs device time.
+
+        ``deadline_s``: the coordinator's remaining deadline budget —
+        shipped in the X-Pilosa-Tpu-Deadline header (the remote inherits
+        it) and used to clamp the socket timeout."""
+        headers, timeout = self._deadline_extras(deadline_s, self.timeout)
         out = self._json(host, "POST", f"/internal/query/{index}", {
             "calls": [call_to_wire(c) for c in calls],
             "shards": shards,
-        })
+        }, timeout=timeout, headers=headers)
         return ([result_from_wire(r) for r in out["results"]],
                 float(out.get("execS", 0.0)))
 
@@ -492,6 +698,8 @@ class Node:
         self.id = node_id
         self.host = host
         self.state = NODE_READY
+        # consecutive probe failures (health-down-threshold gate)
+        self.probe_fails = 0
 
     def to_dict(self, coordinator_id: str) -> dict:
         return {"id": self.id, "uri": self.host,
@@ -509,7 +717,9 @@ class Cluster:
     """
 
     def __init__(self, node_id: str, hosts: list[str], replica_n: int = 1,
-                 holder=None, hasher=None, health_interval: float = 5.0):
+                 holder=None, hasher=None, health_interval: float = 5.0,
+                 health_down_threshold: int = 2,
+                 breaker_threshold: int = 5, stats=None):
         self.nodes = [Node(f"node{i}", h) for i, h in enumerate(hosts)]
         self.by_id = {n.id: n for n in self.nodes}
         if node_id not in self.by_id:
@@ -521,7 +731,17 @@ class Cluster:
         self.replica_n = replica_n
         self.placement = Placement([n.id for n in self.nodes],
                                    replica_n=replica_n, hasher=hasher)
-        self.client = InternalClient()
+        # soft probe failures (timeouts, resets) needed before NODE_DOWN;
+        # a refused connection (nothing listening) flips immediately —
+        # see _note_probe_failure
+        self.health_down_threshold = max(1, health_down_threshold)
+        # breaker half-open trials ride the health cadence, so breaker
+        # state and probe-driven NODE_DOWN converge on the same answer
+        self.client = InternalClient(
+            breaker_threshold=breaker_threshold,
+            breaker_cooldown=max(health_interval, 1.0)
+            if health_interval > 0 else 5.0,
+            stats=stats)
         self.api = None
         self.state = STATE_STARTING
         self.health_interval = health_interval
@@ -555,6 +775,19 @@ class Cluster:
         self._load_topology()
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * len(self.nodes)))
+        # DEDICATED probe pool: health probes must never queue behind
+        # query fan-out RPCs blocked on a hung peer's socket timeout in
+        # the shared pool — that would delay NODE_DOWN detection (and
+        # the breaker's half-open trial) by exactly the latency the
+        # probes exist to bound
+        self._probe_pool = ThreadPoolExecutor(
+            max_workers=max(2, len(self.nodes)),
+            thread_name_prefix="ptpu-probe")
+        # One probe pass at a time: the health thread and an explicit
+        # probe_peers() call must not interleave, or a pass that gathered
+        # its results while a peer was still dead could apply a stale
+        # DOWN after a newer pass already marked the recovered peer READY
+        self._probe_serial = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -571,6 +804,7 @@ class Cluster:
     def close(self):
         self._closing.set()
         self._pool.shutdown(wait=False)
+        self._probe_pool.shutdown(wait=False)
         self.client.close()
 
     @property
@@ -596,15 +830,72 @@ class Cluster:
         while not self._closing.wait(self.health_interval):
             self.probe_peers()
 
+    # floor for the per-probe timeout so tiny health intervals (tests)
+    # don't flap probes on scheduler jitter
+    PROBE_TIMEOUT_MIN = 2.0
+
+    def _probe_timeout(self) -> float:
+        if self.health_interval <= 0:
+            return self.client.timeout
+        return min(self.client.timeout,
+                   max(2 * self.health_interval, self.PROBE_TIMEOUT_MIN))
+
+    def _probe_status(self, node, timeout):
+        try:
+            return self.client.status(node.host, timeout=timeout,
+                                      probe=True), None
+        except Exception as e:
+            return None, e
+
+    def _note_probe_failure(self, n: Node, err: Exception):
+        """One probe miss is not death (cluster.go:1724 confirmNodeDown):
+        soft failures (timeouts, resets) need health_down_threshold
+        CONSECUTIVE misses before NODE_DOWN so a transient hiccup can't
+        flip the cluster DEGRADED.  A DEFINITE failure — connection
+        refused, i.e. nothing is listening — flips immediately, and an
+        already-DOWN node stays down.  (Probes bypass an open breaker as
+        its half-open trial, so CircuitOpenError never reaches here.)"""
+        n.probe_fails += 1
+        if isinstance(err, ConnectionRefusedError) \
+                or n.state == NODE_DOWN \
+                or n.probe_fails >= self.health_down_threshold:
+            n.state = NODE_DOWN
+
     def probe_peers(self):
-        for n in self.peers():
+        # One pass at a time (see _probe_serial): a pass's gathered
+        # results must be applied before the next pass starts, or a
+        # stale failure could overwrite a newer recovery.
+        with self._probe_serial:
+            self._probe_peers_serialized()
+
+    def _probe_peers_serialized(self):
+        # Probe CONCURRENTLY over the dedicated pool: one hung peer must
+        # cost one probe timeout of wall clock, not serialize the whole
+        # loop behind its full socket timeout (r6 issue).  State is
+        # applied sequentially below once every future resolves.
+        peers = self.peers()
+        timeout = self._probe_timeout()
+        try:
+            futs = [(n, self._probe_pool.submit(self._probe_status, n,
+                                                timeout))
+                    for n in peers]
+        except RuntimeError:
+            return  # pool shut down: close() raced the health thread
+        for n, fut in futs:
+            st, err = fut.result()
             was_down = n.state == NODE_DOWN
-            try:
-                st = self.client.status(n.host)
-                n.state = NODE_READY
-            except Exception:
-                n.state = NODE_DOWN
+            if st is None:
+                self._note_probe_failure(n, err)
                 continue
+            n.probe_fails = 0
+            n.state = NODE_READY
+            if was_down:
+                # every pooled connection to the peer predates its
+                # outage/restart — invalidate them BEFORE any traffic
+                # (writes included) re-targets the node, or a stale
+                # keep-alive's response-phase failure turns recovery
+                # into spurious non-retryable POST errors
+                self.client.note_recovered(n.host)
             peer_epoch = st.get("epoch")
             if (self.is_coordinator and peer_epoch is not None
                     and peer_epoch < self.epoch):
@@ -716,7 +1007,18 @@ class Cluster:
 
     # -- query fan-out (executor.go:2455 mapReduce) ------------------------
 
-    def execute(self, index: str, query, shards=None) -> list[Any]:
+    def execute(self, index: str, query, shards=None,
+                ctx=None) -> list[Any]:
+        """``ctx``: optional QueryContext (utils/deadline.py); installed
+        as the current context for the whole fan-out so remotes inherit
+        the remaining budget and retry waves abort once it expires."""
+        from ..utils.deadline import activate
+        if ctx is None:
+            ctx = current_ctx()
+        with activate(ctx):
+            return self._execute_ctx(index, query, shards)
+
+    def _execute_ctx(self, index: str, query, shards) -> list[Any]:
         if isinstance(query, str):
             query = parse(query)
         if self.holder.index(index) is None:
@@ -745,8 +1047,11 @@ class Cluster:
             results = self._execute_calls_batched(index, query.calls,
                                                   shards)
         else:
-            results = [self._execute_call(index, c, shards)
-                       for c in query.calls]
+            from ..utils.deadline import check_current
+            results = []
+            for c in query.calls:
+                check_current("cluster call dispatch")
+                results.append(self._execute_call(index, c, shards))
         if translator.needs_translation(index):
             results = translator.translate_results(index, query.calls,
                                                    results)
@@ -842,9 +1147,12 @@ class Cluster:
         exclude: set[str] = set()
         pending = list(shards)
         last_err: Exception | None = None
+        ctx = current_ctx()
         for _attempt in range(len(self.nodes) + 1):
             if not pending:
                 break
+            if ctx is not None:
+                ctx.check("cluster fan-out")
             try:
                 groups = self._group_shards(index, pending, exclude)
             except ClusterError:
@@ -862,12 +1170,19 @@ class Cluster:
                 groups = self._group_shards(index, pending, exclude)
             futures = {}
             local_shards = groups.pop(self.node_id, None)
+            # remotes inherit the coordinator's REMAINING budget (wire
+            # header + clamped socket timeout), computed per wave so
+            # retries shrink it further
+            deadline_s = ctx.remaining() if ctx is not None else None
             for nid, nshards in groups.items():
+                # deadline rides as an extra arg ONLY when a budget is
+                # set, so the un-budgeted call convention stays stable
+                args = (self.by_id[nid].host, index, calls, nshards)
+                if deadline_s is not None:
+                    args += (deadline_s,)
                 futures[nid] = (nshards, time.perf_counter(),
                                 self._pool.submit(
-                                    self.client.query_calls,
-                                    self.by_id[nid].host, index, calls,
-                                    nshards))
+                                    self.client.query_calls, *args))
             if local_shards is not None:
                 with stats.timer("cluster.multi.local_exec"):
                     for i, r in enumerate(self.api.executor.execute(
@@ -883,6 +1198,14 @@ class Cluster:
                                  max(elapsed - exec_s, 0.0))
                     for i, r in enumerate(res):
                         out[i].append(r)
+                except CircuitOpenError as e:
+                    # fail-fast: the peer's breaker is open (N consecutive
+                    # transport failures) — treat like a dead node, not an
+                    # application error from a live one
+                    last_err = e
+                    self._mark_down(nid)
+                    exclude.add(nid)
+                    pending.extend(nshards)
                 except ClusterError as e:
                     # the peer RESPONDED (HTTP error): it is alive, so an
                     # application-level failure must not poison
@@ -898,8 +1221,12 @@ class Cluster:
             if not pending:
                 break
         else:
+            if ctx is not None:
+                ctx.check("cluster fan-out")  # expired -> 504, not 500
             raise ClusterError("query retries exhausted") from last_err
         if pending:
+            if ctx is not None:
+                ctx.check("cluster fan-out")  # expired -> 504, not 500
             raise ClusterError(
                 f"no replicas available for shards {pending} of "
                 f"{index!r}") from last_err
@@ -1619,6 +1946,7 @@ class Cluster:
                                    hasher=self.placement.hasher)
 
     def _save_topology(self):
+        from ..utils.durable import durable_replace, fsync_file
         path = self._topology_path()
         if path is None:
             return
@@ -1627,13 +1955,17 @@ class Cluster:
         with open(tmp, "w") as f:
             json.dump({"epoch": self.epoch, "replicaN": self.replica_n,
                        "membership": self._membership()}, f)
-        os.replace(tmp, path)
+            # a crash must not leave a node on the PRE-resize membership
+            # after it acked the new one (split-brain on restart)
+            fsync_file(f)
+        durable_replace(tmp, path)
 
     # -- resize job record (cluster.go:1413-1441 resizeJob): persisted on
     #    the coordinator between phase 1 and 2 so a crash mid-completion
     #    can be re-driven instead of diverging ---------------------------
 
     def _save_resize_job(self, job: dict):
+        from ..utils.durable import durable_replace, fsync_file
         path = self._resize_job_path()
         if path is None:
             return
@@ -1641,7 +1973,12 @@ class Cluster:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(job, f)
-        os.replace(tmp, path)
+            # this record is the crash-recovery source of truth between
+            # resize phases 1 and 2 — it must be durable BEFORE any node
+            # adopts the new membership, or a power loss leaves a
+            # partially-applied resize that can never reconverge
+            fsync_file(f)
+        durable_replace(tmp, path)
 
     def _load_resize_job(self) -> dict | None:
         path = self._resize_job_path()
@@ -2016,7 +2353,13 @@ class Cluster:
             result = cluster._local_exec(args["index"], call, shards or [])
             return {"result": result_to_wire(result)}
 
-        router.add("POST", "/internal/query/{index}", internal_query)
+        # gate="internal": admission rides the SEPARATE internal slot
+        # pool so coordinator fan-out can never self-deadlock behind
+        # public traffic (server/admission.py); the deadline header is
+        # parsed by the handler and flows into the executor via the
+        # current query context
+        router.add("POST", "/internal/query/{index}", internal_query,
+                   gate="internal")
 
         def cluster_message(req, args):
             cluster.handle_message(req.json())

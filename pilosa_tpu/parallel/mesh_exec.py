@@ -56,6 +56,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core import SHARD_WORDS
 from ..ops import bsi
 from ..executor.plan import eval_plan, parametrize, plan_inputs
+from ..utils.deadline import check_current
+from ..utils.faults import FAULTS
 
 # shard_map moved from jax.experimental (kwarg check_rep) to the jax
 # namespace (kwarg check_vma) across jax releases; gate on what this
@@ -1078,8 +1080,15 @@ class _ShardSchedule:
         return pinned
 
     def __iter__(self):
+        # Deadline + failpoint gate per slice: an expired query aborts
+        # BETWEEN shard slices (check_current raises DeadlineExceeded;
+        # the finally below releases pins, so partial device work is
+        # freed, docs/robustness.md) instead of running to completion.
         if len(self.slices) <= 1:
-            yield from self.slices
+            for sl in self.slices:
+                FAULTS.hit("mesh.slice", key=self.index)
+                check_current("mesh shard slice")
+                yield sl
             return
         budget = self.mexec._budget
         pool = self.mexec._uploader_pool()
@@ -1087,6 +1096,8 @@ class _ShardSchedule:
         pins: list = []
         try:
             for i, sl in enumerate(self.slices):
+                FAULTS.hit("mesh.slice", key=self.index)
+                check_current("mesh shard slice")
                 if fut is not None:
                     # prefetch-hit means the uploader finished BEFORE the
                     # consumer got here (checked via done() — result()

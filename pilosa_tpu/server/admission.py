@@ -1,0 +1,162 @@
+"""Admission control: bounded concurrent-query slots + a bounded wait
+queue in front of the executor.
+
+The stdlib ThreadingHTTPServer spawns a thread per connection, so without
+a gate a burst of queries all execute at once: device dispatch contends,
+every query slows down, and the burst's tail piles onto an already-losing
+position (congestion collapse).  The slot pool bounds concurrency; a
+short bounded wait queue absorbs jitter; everything beyond that is
+rejected IMMEDIATELY with 503 + Retry-After so clients back off instead
+of queueing invisibly inside the server (the reference relies on Go's
+scheduler + fixed worker pools, executor.go:80-110; here the pool is
+explicit).
+
+The ``/internal/`` query plane gets its OWN controller instance: a
+coordinator holding a public slot fans out to peers whose internal
+handling must never compete with (or be starved by) their public
+traffic — otherwise N coordinators' fan-outs could fill every node's
+public pool and deadlock the cluster against itself.
+
+``begin_drain`` flips the controller into drain mode: new work is
+rejected (503, Retry-After) while ``wait_drained`` lets in-flight queries
+finish under a deadline — the graceful-shutdown half of the overload
+armor (Server.close/drain)."""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+
+class AdmissionRejected(Exception):
+    """Query rejected at admission (HTTP 503 + Retry-After)."""
+
+    def __init__(self, msg: str, retry_after: int = 1):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class AdmissionController:
+    """Slot pool + bounded wait queue.
+
+    ``max_slots <= 0`` means unlimited concurrency — in-flight tracking
+    still runs so draining works.  The wait queue holds at most
+    ``2 * max_slots`` waiters (beyond that the server is definitively
+    overloaded and queueing only adds latency); each waiter gives up
+    after ``queue_timeout`` seconds."""
+
+    def __init__(self, max_slots: int = 0, queue_timeout: float = 0.5,
+                 max_queue: int | None = None, stats=None,
+                 name: str = "public"):
+        self.max_slots = max_slots
+        self.queue_timeout = queue_timeout
+        self.max_queue = max_queue if max_queue is not None \
+            else max(1, 2 * max_slots)
+        self.stats = stats
+        self.name = name
+        self._cond = threading.Condition(threading.Lock())
+        self.in_use = 0
+        self.waiting = 0
+        self.draining = False
+        # counters (surfaced at /debug/vars and, via stats, /metrics)
+        self.admitted = 0
+        self.queued = 0
+        self.rejected_busy = 0       # waited queue_timeout, no slot freed
+        self.rejected_queue_full = 0  # wait queue overflow
+        self.rejected_draining = 0
+
+    def _retry_after(self) -> int:
+        return max(1, math.ceil(self.queue_timeout))
+
+    def _count(self, metric: str):
+        if self.stats is not None:
+            self.stats.count(f"admission.{self.name}.{metric}")
+
+    def _reject(self, counter: str, msg: str):
+        setattr(self, counter, getattr(self, counter) + 1)
+        self._count("rejected")
+        raise AdmissionRejected(msg, retry_after=self._retry_after())
+
+    def acquire(self):
+        """Take a slot or raise AdmissionRejected.  Every successful
+        acquire MUST be paired with release()."""
+        with self._cond:
+            if self.draining:
+                self._reject("rejected_draining", "server is draining")
+            if self.max_slots <= 0 or self.in_use < self.max_slots:
+                self.in_use += 1
+                self.admitted += 1
+                self._count("admitted")
+                return
+            if self.waiting >= self.max_queue:
+                self._reject(
+                    "rejected_queue_full",
+                    f"too many concurrent queries "
+                    f"({self.in_use} running, {self.waiting} queued)")
+            self.waiting += 1
+            self.queued += 1
+            deadline = time.monotonic() + self.queue_timeout
+            try:
+                while True:
+                    if self.draining:
+                        self._reject("rejected_draining",
+                                     "server is draining")
+                    if self.in_use < self.max_slots:
+                        self.in_use += 1
+                        self.admitted += 1
+                        self._count("admitted")
+                        return
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        self._reject(
+                            "rejected_busy",
+                            f"no query slot freed within "
+                            f"{self.queue_timeout:.3g}s "
+                            f"({self.in_use} running)")
+                    self._cond.wait(left)
+            finally:
+                self.waiting -= 1
+
+    def release(self):
+        with self._cond:
+            self.in_use -= 1
+            # notify_all: waiters race for the slot AND wait_drained may
+            # be parked on the same condition (tiny scale, not a hot path)
+            self._cond.notify_all()
+
+    # -- drain -------------------------------------------------------------
+
+    def begin_drain(self):
+        """Stop admitting; queued waiters are rejected immediately."""
+        with self._cond:
+            self.draining = True
+            self._cond.notify_all()
+
+    def wait_drained(self, timeout: float) -> bool:
+        """Block until in-flight work finishes (True) or the drain
+        deadline passes (False — the caller closes anyway)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self.in_use > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(left)
+            return True
+
+    def snapshot(self) -> dict:
+        with self._cond:
+            return {
+                "maxSlots": self.max_slots,
+                "queueTimeoutS": self.queue_timeout,
+                "maxQueue": self.max_queue,
+                "inUse": self.in_use,
+                "waiting": self.waiting,
+                "draining": self.draining,
+                "admitted": self.admitted,
+                "queued": self.queued,
+                "rejectedBusy": self.rejected_busy,
+                "rejectedQueueFull": self.rejected_queue_full,
+                "rejectedDraining": self.rejected_draining,
+            }

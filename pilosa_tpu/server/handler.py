@@ -18,9 +18,12 @@ from urllib.parse import parse_qs, urlparse
 
 from .. import __version__
 from ..api import API, ApiError, ConflictError, DisallowedError, NotFoundError
+from ..utils.deadline import (DEADLINE_HEADER, DeadlineExceeded,
+                              QueryContext, activate)
 from ..utils.tracing import GLOBAL_TRACER, TRACE_HEADER
 from ..executor import RowResult, ValCount, RowIdentifiers
 from ..executor.results import GroupCount, Pair
+from .admission import AdmissionRejected
 
 
 def serialize_result(r) -> object:
@@ -41,25 +44,32 @@ def serialize_result(r) -> object:
 
 
 class Router:
-    """Method+regex route table."""
+    """Method+regex route table.
+
+    ``gate`` marks routes that run query execution and therefore pass
+    admission control: "query" rides the public slot pool, "internal"
+    rides the separate node-to-node pool (a coordinator holding a public
+    slot fans out to peers whose internal handling must never queue
+    behind their public traffic — otherwise concurrent coordinators
+    could deadlock the cluster against itself)."""
 
     def __init__(self):
-        self.routes: list[tuple[str, re.Pattern, callable]] = []
+        self.routes: list[tuple[str, re.Pattern, callable, str | None]] = []
 
-    def add(self, method: str, pattern: str, fn):
+    def add(self, method: str, pattern: str, fn, gate: str | None = None):
         rx = re.compile("^" + re.sub(
             r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$")
-        self.routes.append((method, rx, fn))
+        self.routes.append((method, rx, fn, gate))
 
     def match(self, method: str, path: str):
         found_path = False
-        for m, rx, fn in self.routes:
+        for m, rx, fn, gate in self.routes:
             mt = rx.match(path)
             if mt:
                 found_path = True
                 if m == method:
-                    return fn, mt.groupdict()
-        return ("method_not_allowed" if found_path else None), {}
+                    return fn, mt.groupdict(), gate
+        return ("method_not_allowed" if found_path else None), {}, None
 
 
 def build_router(api: API, server=None) -> Router:
@@ -141,7 +151,7 @@ def build_router(api: API, server=None) -> Router:
             out["columnAttrs"] = list(col_attrs.values())
         return out
 
-    r.add("POST", "/index/{index}/query", post_query)
+    r.add("POST", "/index/{index}/query", post_query, gate="query")
 
     def post_import(req, args):
         body = req.json()
@@ -214,6 +224,22 @@ def build_router(api: API, server=None) -> Router:
                 "entries": len(ex.mesh_exec._stack_cache),
                 "executables": len(ex.mesh_exec._cache),
             }
+        # overload armor: slot/queue state, per-peer breaker state, armed
+        # failpoints (docs/robustness.md); deadline-abort and admission
+        # rejection COUNTERS live in "counts" via the stats client
+        if server is not None and getattr(server, "admission",
+                                          None) is not None:
+            out["admission"] = {
+                "public": server.admission.snapshot(),
+                "internal": server.admission_internal.snapshot(),
+            }
+        if server is not None and getattr(server, "cluster",
+                                          None) is not None:
+            out["breakers"] = server.cluster.client.breaker_snapshot()
+        from ..utils.faults import FAULTS
+        armed = FAULTS.snapshot()
+        if armed:
+            out["failpoints"] = armed
         return out
 
     if api.stats is not None:
@@ -331,6 +357,15 @@ class _HandlerClass(BaseHTTPRequestHandler):
     # an unauthenticated default exemption would re-open the
     # memory-exhaustion hole the public cap closes.
     max_body_bytes_internal: int = 0
+    # Overload armor (docs/robustness.md).  admission/admission_internal:
+    # AdmissionController slot pools for gate="query"/"internal" routes
+    # (None = ungated).  default_query_timeout: seconds applied to public
+    # queries that carry no explicit ?timeout=; 0 = unlimited.  stats:
+    # StatsClient for the 503/504 counters.
+    admission = None
+    admission_internal = None
+    default_query_timeout: float = 0.0
+    stats = None
 
     # request helpers
     def json(self):
@@ -380,8 +415,9 @@ class _HandlerClass(BaseHTTPRequestHandler):
                 remaining -= len(chunk)
             return
         self.body = self.rfile.read(length) if length > 0 else b""
-        fn, args = self.router.match(method, parsed.path)
+        fn, args, gate = self.router.match(method, parsed.path)
         trace_id = self.headers.get(TRACE_HEADER)  # handler.go:231 extract
+        ctx = None
         try:
             if fn is None:
                 self._send(404, {"error": f"path not found: {parsed.path}"})
@@ -389,15 +425,58 @@ class _HandlerClass(BaseHTTPRequestHandler):
             if fn == "method_not_allowed":
                 self._send(405, {"error": "method not allowed"})
                 return
-            with GLOBAL_TRACER.span(f"{method} {parsed.path}",
-                                    trace_id=trace_id):
-                out = fn(self, args)
+            # Deadline: an internal hop's header (the coordinator's
+            # REMAINING budget) > explicit ?timeout= > the configured
+            # query-timeout default for public queries.  <= 0 disables.
+            budget = None
+            try:
+                hdr = self.headers.get(DEADLINE_HEADER)
+                if hdr is not None:
+                    budget = float(hdr)
+                elif "timeout" in self._query:
+                    budget = float(self._query["timeout"][0])
+            except (TypeError, ValueError):
+                raise ApiError(
+                    "timeout/deadline must be a number of seconds")
+            if budget is None and gate == "query" \
+                    and self.default_query_timeout > 0:
+                budget = self.default_query_timeout
+            if budget is not None and budget > 0:
+                ctx = QueryContext(budget)
+            adm = self.admission if gate == "query" else \
+                self.admission_internal if gate == "internal" else None
+            admitted = False
+            if adm is not None:
+                adm.acquire()  # raises AdmissionRejected -> 503
+                admitted = True
+            try:
+                with activate(ctx):
+                    if ctx is not None:
+                        ctx.check("admission")
+                    with GLOBAL_TRACER.span(f"{method} {parsed.path}",
+                                            trace_id=trace_id):
+                        out = fn(self, args)
+            finally:
+                if admitted:
+                    adm.release()
             if isinstance(out, tuple):
                 ctype, payload = out
                 self._send_raw(200, ctype, payload.encode()
                                if isinstance(payload, str) else payload)
             else:
                 self._send(200, out)
+        except AdmissionRejected as e:
+            # overload/drain rejection: bounded, explicit, retryable
+            self._send(503, {"error": str(e)},
+                       headers={"Retry-After": str(e.retry_after)})
+        except DeadlineExceeded as e:
+            if self.stats is not None:
+                self.stats.count("query.deadline_abort")
+            body = {"error": str(e)}
+            if ctx is not None:
+                body["elapsedS"] = round(ctx.elapsed(), 4)
+                body["budgetS"] = ctx.budget
+            self._send(504, body)
         except NotFoundError as e:
             self._send(404, {"error": str(e)})
         except ConflictError as e:
@@ -410,14 +489,18 @@ class _HandlerClass(BaseHTTPRequestHandler):
             traceback.print_exc()
             self._send(500, {"error": f"internal error: {e}"})
 
-    def _send(self, code: int, obj):
+    def _send(self, code: int, obj, headers: dict | None = None):
         self._send_raw(code, "application/json",
-                       (json.dumps(obj) + "\n").encode())
+                       (json.dumps(obj) + "\n").encode(), headers)
 
-    def _send_raw(self, code: int, ctype: str, payload: bytes):
+    def _send_raw(self, code: int, ctype: str, payload: bytes,
+                  headers: dict | None = None):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(payload)))
+        if headers:
+            for k, v in headers.items():
+                self.send_header(k, v)
         self.end_headers()
         self.wfile.write(payload)
 
@@ -477,16 +560,28 @@ def make_http_server(api: API, host: str = "localhost", port: int = 10101,
                      server=None, tls=None,
                      max_body_bytes: int | None = None,
                      max_body_bytes_internal: int | None = None,
+                     admission=None, admission_internal=None,
+                     default_query_timeout: float | None = None,
                      ) -> ThreadingHTTPServer:
     """``tls``: optional (certificate, key, ca_certificate|None) paths —
     serves HTTPS, requiring client certificates (mutual TLS) when a CA is
-    given (reference server/tlsconfig.go, server/server.go GetTLSConfig)."""
+    given (reference server/tlsconfig.go, server/server.go GetTLSConfig).
+
+    ``admission``/``admission_internal``: AdmissionController pools for
+    the public and node-to-node query routes; ``default_query_timeout``:
+    deadline applied to public queries without an explicit ?timeout=."""
     router = build_router(api, server)
-    attrs = {"router": router}
+    attrs = {"router": router, "stats": api.stats}
     if max_body_bytes is not None:
         attrs["max_body_bytes"] = max_body_bytes
     if max_body_bytes_internal is not None:
         attrs["max_body_bytes_internal"] = max_body_bytes_internal
+    if admission is not None:
+        attrs["admission"] = admission
+    if admission_internal is not None:
+        attrs["admission_internal"] = admission_internal
+    if default_query_timeout is not None:
+        attrs["default_query_timeout"] = default_query_timeout
     cls = type("Handler", (_HandlerClass,), attrs)
     if tls is None:
         return TrackingHTTPServer((host, port), cls)

@@ -68,6 +68,31 @@ class Config:
     # same as max_body_mb.  Raise only behind mutual TLS — the path
     # prefix is not authentication.
     max_body_internal_mb: int = 0
+    # -- overload armor (docs/robustness.md) -------------------------------
+    # Default end-to-end deadline (seconds) for public queries without an
+    # explicit ?timeout=; expired queries abort between shard slices and
+    # return 504.  0 = unlimited.
+    query_timeout: float = 0.0
+    # Concurrent-query slot pool size (public and internal pools are
+    # SEPARATE instances of this size so coordinator fan-out can never
+    # self-deadlock behind public traffic).  0 = unlimited.
+    max_queries: int = 64
+    # Seconds an over-slot query may wait for a slot before 503 +
+    # Retry-After; the wait queue holds at most 2*max_queries.
+    queue_timeout: float = 0.5
+    # Consecutive node-to-node TRANSPORT failures that open a peer's
+    # circuit breaker (fail-fast ClusterError; half-open probe on the
+    # health cadence).  0 disables breaking.
+    breaker_threshold: int = 5
+    # Graceful-drain budget: close() stops admitting new queries, lets
+    # in-flight ones finish for up to this many seconds, then closes.
+    drain_seconds: float = 5.0
+    # Consecutive SOFT probe failures (timeouts/resets — refused
+    # connections flip immediately) before NODE_DOWN.
+    health_down_threshold: int = 2
+    # Failpoint spec armed at startup (utils/faults.py syntax); empty =
+    # nothing armed.  For chaos tests and game-days only.
+    failpoints: str = ""
     verbose: bool = False
 
     @classmethod
@@ -107,6 +132,14 @@ class Config:
             "PILOSA_TPU_MAX_BODY_MB": ("max_body_mb", int),
             "PILOSA_TPU_MAX_BODY_INTERNAL_MB": ("max_body_internal_mb",
                                                 int),
+            "PILOSA_TPU_QUERY_TIMEOUT": ("query_timeout", float),
+            "PILOSA_TPU_MAX_QUERIES": ("max_queries", int),
+            "PILOSA_TPU_QUEUE_TIMEOUT": ("queue_timeout", float),
+            "PILOSA_TPU_BREAKER_THRESHOLD": ("breaker_threshold", int),
+            "PILOSA_TPU_DRAIN_SECONDS": ("drain_seconds", float),
+            "PILOSA_TPU_HEALTH_DOWN_THRESHOLD": ("health_down_threshold",
+                                                 int),
+            "PILOSA_TPU_FAILPOINTS": ("failpoints", str),
         }
         for env, (attr, conv) in env_map.items():
             if env in os.environ:
@@ -133,6 +166,13 @@ class Config:
             "host-stage-mb": "host_stage_mb",
             "max-body-mb": "max_body_mb",
             "max-body-internal-mb": "max_body_internal_mb",
+            "query-timeout": "query_timeout",
+            "max-queries": "max_queries",
+            "queue-timeout": "queue_timeout",
+            "breaker-threshold": "breaker_threshold",
+            "drain-seconds": "drain_seconds",
+            "health-down-threshold": "health_down_threshold",
+            "failpoints": "failpoints",
         }
         for key, attr in mapping.items():
             if key in doc:
@@ -180,6 +220,12 @@ class Server:
             data_dir, max_op_n=self.config.max_op_n,
             max_row_id=(self.config.max_row_id
                         if self.config.max_row_id > 0 else None))
+        # failpoints (utils/faults.py): config/env-armed chaos injection;
+        # the registry is process-global and a no-op when the spec is
+        # empty (the production default)
+        if self.config.failpoints:
+            from ..utils.faults import FAULTS
+            FAULTS.configure(self.config.failpoints)
         self.cluster = None
         if self.config.cluster_hosts:
             from ..parallel.cluster import Cluster
@@ -188,6 +234,9 @@ class Server:
                 hosts=self.config.cluster_hosts,
                 replica_n=self.config.replica_n,
                 holder=self.holder,
+                health_down_threshold=self.config.health_down_threshold,
+                breaker_threshold=self.config.breaker_threshold,
+                stats=self.stats,
             )
             if not self.cluster.is_coordinator:
                 # key translation lives on the coordinator; replicas route
@@ -206,10 +255,24 @@ class Server:
                     self.config.tls_certificate, self.config.tls_key,
                     self.config.tls_ca_certificate or None,
                     self.config.tls_skip_verify)
+        # Admission control (server/admission.py): separate public and
+        # internal slot pools of the same size — the split, not the
+        # sizing, is what prevents coordinator fan-out from deadlocking
+        # behind public traffic.
+        from .admission import AdmissionController
+        self.admission = AdmissionController(
+            self.config.max_queries, self.config.queue_timeout,
+            stats=self.stats, name="public")
+        self.admission_internal = AdmissionController(
+            self.config.max_queries, self.config.queue_timeout,
+            stats=self.stats, name="internal")
         self.httpd = make_http_server(
             self.api, host, port, server=self, tls=tls,
             max_body_bytes=self.config.max_body_mb << 20,
-            max_body_bytes_internal=self.config.max_body_internal_mb << 20)
+            max_body_bytes_internal=self.config.max_body_internal_mb << 20,
+            admission=self.admission,
+            admission_internal=self.admission_internal,
+            default_query_timeout=self.config.query_timeout)
         from ..utils.diagnostics import DiagnosticsCollector
         self.diagnostics = DiagnosticsCollector(
             self, self.config.diagnostics_endpoint,
@@ -295,6 +358,12 @@ class Server:
         self.stats.gauge("runtime.hbm_pinned_bytes", b["pinnedBytes"])
         self.stats.gauge("runtime.host_stage_bytes",
                          HOST_STAGE_BUDGET.resident_bytes)
+        # admission slot/queue occupancy (counters live in stats counts)
+        for pool in (self.admission, self.admission_internal):
+            s = pool.snapshot()
+            self.stats.gauge(f"admission.{pool.name}.in_use", s["inUse"])
+            self.stats.gauge(f"admission.{pool.name}.waiting",
+                             s["waiting"])
 
     def _monitor_runtime(self):
         while not self._closing.wait(self.config.metric_poll_interval):
@@ -311,7 +380,27 @@ class Server:
             except Exception as e:
                 self.logger.error(f"anti-entropy sync failed: {e}")
 
+    def drain(self, timeout: float | None = None) -> bool:
+        """Graceful drain: stop ADMITTING public queries (new ones get
+        503 + Retry-After while the socket stays up, so clients fail over
+        cleanly) and wait for in-flight ones to finish.  Returns True if
+        everything drained inside the deadline.  Idempotent; close()
+        calls it first."""
+        if timeout is None:
+            timeout = self.config.drain_seconds
+        self.admission.begin_drain()
+        drained = self.admission.wait_drained(max(timeout, 0.0))
+        if not drained:
+            self.logger.error(
+                f"drain deadline ({timeout:.3g}s) passed with "
+                f"{self.admission.snapshot()['inUse']} queries in flight; "
+                f"closing anyway")
+        return drained
+
     def close(self):
+        # drain BEFORE severing sockets: in-flight queries finish under
+        # the drain deadline instead of seeing a connection reset
+        self.drain()
         self._closing.set()
         self.diagnostics.close()
         self.httpd.shutdown()

@@ -13,6 +13,8 @@ import json
 import os
 import threading
 
+from ..utils.durable import durable_replace, fsync_file
+
 _BLOCK_SIZE = 100  # ids per checksum block (attr.go attrBlockSize)
 
 
@@ -32,7 +34,10 @@ class AttrStore:
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({str(k): v for k, v in self._attrs.items()}, f)
-        os.replace(tmp, self.path)
+            # fsync before the rename + dir fsync after: a crash right
+            # after os.replace must not lose an acknowledged attr write
+            fsync_file(f)
+        durable_replace(tmp, self.path)
 
     def attrs(self, id_: int) -> dict:
         with self._lock:

@@ -51,6 +51,8 @@ from ..core import (
     SHARD_WORDS,
 )
 from ..ops import bitset, bsi
+from ..utils.durable import durable_replace, fsync_file
+from ..utils.faults import FAULTS
 from .membudget import DEFAULT_BUDGET, HOST_STAGE_BUDGET
 
 # On-disk snapshot formats.
@@ -252,12 +254,17 @@ class Fragment:
                 self._op_n = 0
                 return
             tmp = self.path + ".snapshotting"
+            FAULTS.hit("fragment.snapshot", key=self.path)
             with open(tmp, "wb") as f:
                 f.write(_HEADER.pack(_MAGIC_V3, self._cap_rows, SHARD_WORDS,
                                      self._idx.size))
                 self._idx.astype("<u8").tofile(f)
                 self._val.astype("<u4").tofile(f)
-            os.replace(tmp, self.path)
+                # fsync BEFORE the rename: tofile lands in the page cache,
+                # and a crash after os.replace would otherwise lose an
+                # acknowledged snapshot (the WAL it replaced is truncated)
+                fsync_file(f)
+            durable_replace(tmp, self.path)
             self._dirty_data = False
             if self._wal_file is not None:
                 self._wal_file.close()
@@ -406,6 +413,7 @@ class Fragment:
 
     def _log_op(self, op: int, row: int, col: int):
         if self._wal_file is not None:
+            FAULTS.hit("fragment.wal", key=self.path or "")
             self._wal_file.write(_OP.pack(op, row, col))
         self._op_n += 1
         if self._op_n >= self.max_op_n:
@@ -416,6 +424,7 @@ class Fragment:
     def _log_ops(self, op: int, rows: np.ndarray, cols: np.ndarray):
         """Vectorized batch append: one record-array build + one write."""
         if self._wal_file is not None:
+            FAULTS.hit("fragment.wal", key=self.path or "")
             recs = np.empty(rows.size, dtype=_OP_DTYPE)
             recs["op"] = op
             recs["row"] = rows

@@ -1,0 +1,41 @@
+"""Crash-durable file replacement.
+
+``buffering=0`` / plain writes land in the page cache; ``os.replace``
+orders the rename but not the data, so a crash shortly after an
+acknowledged snapshot could surface an empty or stale file.  The durable
+sequence is: flush+fsync the temp file, rename, then fsync the DIRECTORY
+so the rename itself is on stable storage (the same discipline the
+reference gets from bolt/roaring file syncs)."""
+
+from __future__ import annotations
+
+import os
+
+
+def fsync_file(f):
+    """Flush a writable file object's data to stable storage."""
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def fsync_dir(path: str):
+    """fsync a directory so a completed rename within it is durable.
+    Best-effort: platforms/filesystems that refuse O_RDONLY-dir fsync
+    (some network mounts) degrade to the pre-fsync behavior."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def durable_replace(tmp: str, path: str):
+    """``os.replace(tmp, path)`` + directory fsync (the temp file must
+    already be fsynced by the writer — see fsync_file)."""
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path) or ".")

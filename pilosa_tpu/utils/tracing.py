@@ -22,23 +22,32 @@ class Span:
         self.trace_id = trace_id
         self.span_id = uuid.uuid4().hex[:8]
         self.parent_id = parent_id
+        # wall-clock start for display/correlation; durations come from a
+        # perf_counter pair — a wall-clock step (NTP slew, manual set)
+        # mid-span must not produce negative/garbage durations in
+        # /debug/traces
         self.start = time.time()
+        self._pc_start = time.perf_counter()
         self.end: float | None = None
+        self.duration: float | None = None
         self.tags: dict = {}
 
     def set_tag(self, key, value):
         self.tags[key] = value
 
     def finish(self):
-        self.end = time.time()
+        self.duration = time.perf_counter() - self._pc_start
+        self.end = self.start + self.duration
         self.tracer._record(self)
 
     def to_dict(self) -> dict:
+        dur = self.duration if self.duration is not None \
+            else time.perf_counter() - self._pc_start
         return {
             "name": self.name, "traceID": self.trace_id,
             "spanID": self.span_id, "parentID": self.parent_id,
             "start": self.start,
-            "durationMS": ((self.end or time.time()) - self.start) * 1e3,
+            "durationMS": dur * 1e3,
             "tags": self.tags,
         }
 
