@@ -769,6 +769,77 @@ def run_overload_smoke() -> dict:
         srv.close()
 
 
+def _clear_query_caches(ex):
+    """Flush both cache layers (the /internal/cache/clear admin route's
+    in-process analog) so a 'cold' measurement is genuinely cold."""
+    from pilosa_tpu.cache.rank import iter_rank_caches
+
+    ex.result_cache.clear()
+    for _frag, cache in iter_rank_caches(ex.holder):
+        cache.invalidate()
+
+
+def run_cache_smoke(rng) -> dict:
+    """Cache leg of --smoke (docs/caching.md): repeated unfiltered
+    TopN/Count on unchanged data, cold (both cache layers flushed before
+    every run) vs warm (result-cache hits).  Asserts the acceptance
+    floor — warm >= 5x faster than cold — and reports the hit ratio."""
+    from pilosa_tpu.core import SHARD_WIDTH
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage import Holder
+
+    h = Holder(None)
+    idx = h.create_index("cachesmoke", track_existence=False)
+    f = idx.create_field("f")
+    n_bits = 200_000
+    f.import_bits(rng.integers(0, 64, size=n_bits),
+                  rng.integers(0, 4 * SHARD_WIDTH, size=n_bits))
+    ex = Executor(h, use_mesh=True)
+    ex.result_cache.limit_bytes = 64 << 20
+    queries = ["TopN(f, n=10)", "Count(Row(f=7))",
+               "Count(Intersect(Row(f=1), Row(f=2)))"]
+    try:
+        # compile warm-up with DISTINCT literals: the cold timings below
+        # must measure execution + cache builds, not XLA compilation
+        ex.execute("cachesmoke", "TopN(f, n=9) Count(Row(f=6)) "
+                                 "Count(Intersect(Row(f=3), Row(f=4)))")
+
+        def once():
+            t0 = time.perf_counter()
+            for q in queries:
+                ex.execute("cachesmoke", q)
+            return time.perf_counter() - t0
+
+        colds = []
+        for _ in range(3):
+            _clear_query_caches(ex)
+            colds.append(once())
+        cold_s = float(np.median(colds))
+        _clear_query_caches(ex)
+        once()  # fill
+        h0, m0 = ex.result_cache.hits, ex.result_cache.misses
+        warms = [once() for _ in range(15)]
+        warm_s = float(np.median(warms))
+        hits = ex.result_cache.hits - h0
+        misses = ex.result_cache.misses - m0
+        speedup = cold_s / warm_s if warm_s > 0 else float("inf")
+        assert hits == 15 * len(queries) and misses == 0, \
+            f"warm repeats were not served from the cache " \
+            f"({hits} hits, {misses} misses)"
+        assert speedup >= 5, \
+            f"warm repeats only {speedup:.1f}x faster than cold " \
+            f"(acceptance floor is 5x)"
+        return {
+            "cold_ms": round(cold_s * 1e3, 2),
+            "warm_ms": round(warm_s * 1e3, 3),
+            "speedup": round(speedup, 1),
+            "hit_ratio": round(hits / (hits + misses), 3),
+            "resident_bytes": ex.result_cache.resident_bytes,
+        }
+    finally:
+        ex.close()
+
+
 def run_smoke():
     """--smoke: seconds-scale end-to-end exercise of the resident AND the
     budgeted/streaming query paths on tiny shard counts — wired as a
@@ -835,6 +906,7 @@ def run_smoke():
     finally:
         DEFAULT_BUDGET.limit_bytes = old_limit
         ex5.close()
+    out["cache"] = run_cache_smoke(np.random.default_rng(SEED + 3))
     out["overload"] = run_overload_smoke()
     out["total_s"] = round(time.perf_counter() - t_start, 2)
     print(json.dumps(out))

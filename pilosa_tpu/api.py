@@ -146,8 +146,10 @@ class API:
         idx = self.holder.index(index)
         if idx is None:
             raise NotFoundError(f"index not found: {index}")
-        opts = FieldOptions.from_dict(options or {})
         try:
+            # from_dict validates cacheType/cacheSize (FieldOptions
+            # __post_init__) — bad options must 400, not 500
+            opts = FieldOptions.from_dict(options or {})
             f = idx.create_field(field, opts)
         except FileExistsError as e:
             raise ConflictError(str(e))
@@ -347,13 +349,22 @@ class API:
                   "state": "READY"}]
         state = STATE_NORMAL
         epoch = 0
+        out = {}
         if self.cluster is not None:
             nodes = self.cluster.node_statuses()
             state = self.cluster.state
             epoch = self.cluster.epoch
-        return {"state": state, "nodes": nodes, "epoch": epoch,
-                "localID": nodes[0]["id"] if self.cluster is None
-                else self.cluster.node_id}
+            # per-index fragment-gen summaries ride the health probes so
+            # peers' result caches see out-of-band writes within one
+            # probe interval (cache/results.py gen_summary)
+            from .cache.results import gen_summary
+            out["dataGens"] = {
+                name: list(gen_summary(self.holder, name))
+                for name in list(self.holder.indexes)}
+        out.update({"state": state, "nodes": nodes, "epoch": epoch,
+                    "localID": nodes[0]["id"] if self.cluster is None
+                    else self.cluster.node_id})
+        return out
 
     def info(self) -> dict:
         self._validate("Info")
@@ -380,7 +391,11 @@ class API:
         return self.cluster.shard_nodes_info(index, shard)
 
     def recalculate_caches(self):
+        """(api.go RecalculateCaches): eagerly rebuild every fragment's
+        rank cache so the next TopN doesn't pay the lazy rebuild."""
         self._validate("RecalculateCaches")
-        # Per-fragment TopN caches are recomputed exactly on device per
-        # query; nothing stale to recalculate.
+        from .cache.rank import iter_rank_caches
+        for frag, cache in iter_rank_caches(self.holder):
+            with frag._lock:
+                cache.build(frag)
         return None

@@ -1,0 +1,19 @@
+"""Query cache subsystem (reference cache.go RankCache / lru.Cache).
+
+Two cooperating layers:
+
+* ``rank``   — per-fragment rank/LRU caches of the hottest rows, honoring
+  the field's ``cacheType``/``cacheSize`` (cache.go:40 rankCache,
+  consulted by fragment.go:1570 top).  Unlike the reference, TopN answers
+  derived from these caches stay EXACT: the cache only prunes the
+  candidate set, and pruning is used only when it can prove coverage.
+* ``results`` — a generation-keyed result cache memoizing finished query
+  results; invalidation is structural (fragment ``gen`` stamps bumped by
+  every mutation key the entries), never TTL-based.
+"""
+
+from .rank import RankCache, iter_rank_caches, topn_from_rank
+from .results import ResultCache, gen_summary, gen_vector
+
+__all__ = ["RankCache", "iter_rank_caches", "topn_from_rank",
+           "ResultCache", "gen_summary", "gen_vector"]

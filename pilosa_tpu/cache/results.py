@@ -1,0 +1,201 @@
+"""Generation-keyed result cache.
+
+Memoizes finished read-query results keyed by (scope, index, normalized
+query repr, shard set) PLUS everything the answer is a pure function of:
+the index's fragment GENERATION VECTOR (every fragment stamps a unique,
+monotonically increasing ``gen`` on mutation — storage/fragment.py:132),
+the schema epoch (DDL / BSI depth growth), and the attr epoch (row/column
+attribute writes).  Invalidation is therefore STRUCTURAL, never TTL-based:
+a mutation changes a gen, the current key stops matching, and the stale
+entry simply ages out of the LRU.  Local writes, remote imports received
+on ``/internal/import/*``, and anti-entropy block repairs all go through
+the same fragment mutators, so they all bump gens and thereby invalidate
+exactly the affected entries.
+
+The cluster layer adds a remote component to coordinator-scope keys: gen
+summaries piggybacked on ``/internal/query`` responses and ``/status``
+probes, plus a per-(index, peer) write version bumped whenever this node
+forwards a write/import/repair to that peer (parallel/cluster.py).
+
+Entries are LRU-bounded by bytes (``result-cache-mb``; 0 disables).  A
+fill that supersedes an older entry for the same (scope, index, query,
+shards) under different generations counts as an INVALIDATION and evicts
+the stale entry eagerly, so churned queries don't pool garbage.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+
+# -- generation vectors ------------------------------------------------------
+
+def gen_vector(holder, index: str, shards=None) -> tuple:
+    """Precise per-fragment generation vector of ``index`` (optionally
+    restricted to a shard set) — the local component of a cache key.
+    Fragment creation/deletion changes the tuple shape, so appearing and
+    vanishing fragments invalidate too."""
+    idx = holder.index(index)
+    if idx is None:
+        return ()
+    parts = []
+    for fname, f in sorted(idx.fields.items()):
+        for vname, v in sorted(f.views.items()):
+            for shard, frag in sorted(v.fragments.items()):
+                if shards is None or shard in shards:
+                    parts.append((fname, vname, shard, frag.gen))
+    return tuple(parts)
+
+
+def gen_summary(holder, index: str) -> tuple[int, int, int]:
+    """Compact (count, max, sum) of the index's fragment gens for wire
+    piggybacking.  Gens come from one strictly increasing process counter,
+    so ``max`` strictly increases on ANY mutation and ``count`` moves on
+    fragment create/GC — the triple changes whenever the data does."""
+    idx = holder.index(index)
+    if idx is None:
+        return (0, 0, 0)
+    n = mx = total = 0
+    for f in list(idx.fields.values()):
+        for v in list(f.views.values()):
+            for frag in list(v.fragments.values()):
+                g = frag.gen
+                n += 1
+                total += g
+                if g > mx:
+                    mx = g
+    return (n, mx, total)
+
+
+def query_is_readonly(query) -> bool:
+    """True when no call in the tree mutates state (Options can wrap
+    writes, so the check is recursive)."""
+    from ..pql.ast import WRITE_CALLS
+
+    def walk(c):
+        if c.name in WRITE_CALLS:
+            return False
+        return all(walk(ch) for ch in c.children)
+
+    return all(walk(c) for c in query.calls)
+
+
+def _result_bytes(results) -> int:
+    """Conservative host-byte estimate of a results list (for the LRU
+    byte budget)."""
+    total = 64
+    for r in results:
+        total += 64
+        segments = getattr(r, "segments", None)
+        if segments is not None:
+            for seg in segments.values():
+                total += np.asarray(seg).nbytes
+        elif isinstance(r, list):
+            total += 64 * len(r)
+        rows = getattr(r, "rows", None)
+        if isinstance(rows, list):
+            total += 8 * len(rows)
+    return total
+
+
+def _host_results(results):
+    """Pull RowResult segments to host numpy IN PLACE: cached entries must
+    not pin device (HBM) buffers, and every consumer already accepts
+    numpy segments (the non-mesh path returns them natively)."""
+    for r in results:
+        segments = getattr(r, "segments", None)
+        if segments is not None:
+            r.segments = {s: np.asarray(seg) for s, seg in segments.items()}
+    return results
+
+
+class ResultCache:
+    """(scope…, gens…) -> results list; thread-safe, LRU by bytes.
+
+    ``limit_bytes == 0`` disables lookups and fills entirely (the bare-
+    Executor default; the server wires ``result-cache-mb`` through)."""
+
+    def __init__(self, limit_bytes: int = 0, stats=None):
+        self.limit_bytes = limit_bytes
+        self.stats = stats
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()  # key -> (results, nbytes)
+        self._by_query: dict = {}  # qkey -> full key (stale-entry sweep)
+        self.resident_bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evicts = 0
+        self.invalidates = 0
+
+    def _count(self, name: str):
+        if self.stats is not None:
+            self.stats.count(name)
+
+    def lookup(self, key):
+        """Cached results list (shallow copy) or None."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+        self._count("resultcache.hit" if entry is not None
+                    else "resultcache.miss")
+        return list(entry[0]) if entry is not None else None
+
+    def fill(self, qkey, key, results):
+        """Insert under ``key``; ``qkey`` is the generation-free prefix
+        used to eagerly drop a superseded (stale-gen) entry."""
+        nbytes = _result_bytes(results)
+        if nbytes > self.limit_bytes:
+            return  # larger than the whole budget: never admit
+        results = _host_results(results)
+        with self._lock:
+            old_key = self._by_query.get(qkey)
+            if old_key is not None and old_key != key:
+                old = self._entries.pop(old_key, None)
+                if old is not None:
+                    self.resident_bytes -= old[1]
+                    self.invalidates += 1
+                    self._count("resultcache.invalidate")
+            self._by_query[qkey] = key
+            prev = self._entries.pop(key, None)
+            if prev is not None:
+                self.resident_bytes -= prev[1]
+            self._entries[key] = (results, nbytes)
+            self.resident_bytes += nbytes
+            while self.resident_bytes > self.limit_bytes and self._entries:
+                _k, (_r, nb) = self._entries.popitem(last=False)
+                self.resident_bytes -= nb
+                self.evicts += 1
+                self._count("resultcache.evict")
+            # _by_query is bookkeeping only; prune dangling pointers so it
+            # cannot outgrow the entry table
+            if len(self._by_query) > 2 * len(self._entries) + 64:
+                live = set(self._entries)
+                self._by_query = {q: k for q, k in self._by_query.items()
+                                  if k in live}
+
+    def clear(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self._by_query.clear()
+            self.resident_bytes = 0
+        return n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self.resident_bytes,
+                "limitBytes": self.limit_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evicts": self.evicts,
+                "invalidates": self.invalidates,
+            }

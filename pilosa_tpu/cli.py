@@ -217,6 +217,9 @@ data-dir = "~/.pilosa_tpu"
 bind = "localhost:10101"
 max-op-n = 10000
 # max-body-mb = 1024
+# query cache subsystem (docs/caching.md)
+# result-cache-mb = 256    # generation-keyed result cache budget, 0 = off
+# rank-rebuild-rows = 4096 # incremental rank-cache ceiling per batch
 # overload armor (docs/robustness.md)
 # query-timeout = 0        # default per-query deadline seconds, 0 = off
 # max-queries = 64         # concurrent-query slots (public + internal)
@@ -253,6 +256,8 @@ def cmd_config(args) -> int:
     print(f"use-mesh = {str(cfg.use_mesh).lower()}")
     print(f"device-budget-mb = {cfg.device_budget_mb}")
     print(f"max-body-mb = {cfg.max_body_mb}")
+    print(f"result-cache-mb = {cfg.result_cache_mb}")
+    print(f"rank-rebuild-rows = {cfg.rank_rebuild_rows}")
     print(f"query-timeout = {cfg.query_timeout}")
     print(f"max-queries = {cfg.max_queries}")
     print(f"queue-timeout = {cfg.queue_timeout}")

@@ -82,6 +82,23 @@ def schema_epoch() -> int:
     return _schema_epoch
 
 
+# Process-wide attribute generation counter.  Row/column attributes ride
+# query results (Row attrs, Options(columnAttrs)) but live outside the
+# fragment stores, so their writes bump no fragment gen; the result cache
+# (cache/results.py) keys entries to this counter instead so an attr write
+# invalidates structurally like any other mutation.
+_attr_epoch = 0
+
+
+def bump_attr_epoch():
+    global _attr_epoch
+    _attr_epoch += 1
+
+
+def attr_epoch() -> int:
+    return _attr_epoch
+
+
 _NAME_RE = re.compile(r"[a-z][a-z0-9_-]*")
 
 

@@ -308,6 +308,13 @@ class Executor:
         self.stats = stats if stats is not None else NopStatsClient()
         from .translator import Translator
         self.translator = Translator(holder)
+        # Generation-keyed result cache (cache/results.py).  Disabled on
+        # bare executors (limit 0) so tests and chaos harnesses exercise
+        # the real execution path; the server wires ``result-cache-mb``
+        # through, and the cluster layer reuses this same instance for
+        # coordinator-scope entries (one shared byte budget).
+        from ..cache.results import ResultCache
+        self.result_cache = ResultCache(stats=self.stats)
         self.mesh_exec = None
         self.prepared = None
         if mesh is not None or use_mesh:
@@ -344,6 +351,33 @@ class Executor:
                      check_current) -> list[Any]:
         check_current("execute")
         stats = self.stats
+        # Result-cache lookup FIRST (before even the parse): node-local
+        # entries key on the query text (an AST keys on its normalized
+        # repr), the pinned shard set, and the index's fragment
+        # generation vector — any mutation bumps a gen and the key stops
+        # matching (cache/results.py).
+        qkey = ckey = None
+        cache = self.result_cache
+        if cache is not None and cache.limit_bytes > 0:
+            idx0 = self.holder.index(index_name)
+            if idx0 is not None:
+                if shards is None:
+                    shards = sorted(idx0.available_shards())
+                from ..core import attr_epoch, schema_epoch
+                from ..cache.results import gen_vector
+                qrepr = query if isinstance(query, str) else repr(query)
+                qkey = ("local", index_name, qrepr, tuple(shards),
+                        bool(translate))
+                ckey = qkey + (gen_vector(self.holder, index_name,
+                                          set(shards)),
+                               schema_epoch(), attr_epoch())
+                from ..utils.tracing import GLOBAL_TRACER
+                with GLOBAL_TRACER.span("resultcache.lookup") as span:
+                    out = cache.lookup(ckey)
+                    span.set_tag("outcome",
+                                 "hit" if out is not None else "miss")
+                if out is not None:
+                    return out
         if isinstance(query, str):
             if translate and self.prepared is not None:
                 with stats.timer("query.prepared"):
@@ -351,6 +385,10 @@ class Executor:
                                                      shards)
                 if hit:
                     stats.count("query.prepared.hit")
+                    if ckey is not None:
+                        # prepared entries exist only for Count/Sum/TopN
+                        # templates — read-only by construction
+                        cache.fill(qkey, ckey, out)
                     return out
                 stats.count("query.prepared.miss")
                 if out is not None:
@@ -389,6 +427,10 @@ class Executor:
         if translate and self.translator.needs_translation(index_name):
             results = self.translator.translate_results(
                 index_name, query.calls, results)
+        if ckey is not None:
+            from ..cache.results import query_is_readonly
+            if query_is_readonly(query):
+                cache.fill(qkey, ckey, results)
         return results
 
     # -- batched multi-call execution --------------------------------------
@@ -723,6 +765,20 @@ class Executor:
         n, _ = c.uint_arg("n")
         ids = c.args.get("ids")
         tan_thresh, attr_name, attr_values = topn_extras(c)
+
+        # Unfiltered TopN first consults the field's per-fragment rank
+        # caches (cache/rank.py; the reference's fragment.go:1570 top →
+        # cache.go rankCache hot path).  Candidate pruning stays EXACT:
+        # the cache answers only when it can prove the pruned rows cannot
+        # reach the top n, and otherwise this falls through to the full
+        # scan below.
+        if not c.children and ids is None and tan_thresh is None \
+                and attr_name is None \
+                and f.options.cache_type in ("ranked", "lru"):
+            from ..cache.rank import topn_from_rank
+            pairs = topn_from_rank(f, shards, n, stats=self.stats)
+            if pairs is not None:
+                return pairs
 
         if self.mesh_exec is not None:
             # one shard_map computation: per-row popcounts masked by the

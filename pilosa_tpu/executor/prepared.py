@@ -376,6 +376,14 @@ class PreparedCache:
         field_name, ok = c.string_arg("_field")
         if not ok or ex.holder.field(index, field_name) is None:
             return None
+        if not c.children and "ids" not in c.args and \
+                ex.holder.field(index, field_name).options.cache_type \
+                in ("ranked", "lru"):
+            # unfiltered TopN on a rank-cached field belongs to the rank
+            # cache's exact candidate path (executor._execute_topn ->
+            # cache/rank.topn_from_rank) — host-side, no device dispatch;
+            # a prepared replay would re-route it to a full device scan
+            return None
         if c.children:
             slotted, params, prov, pg = slot_plan(c.children[0])
         else:

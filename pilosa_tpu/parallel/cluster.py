@@ -488,14 +488,18 @@ class InternalClient:
 
         ``deadline_s``: the coordinator's remaining deadline budget —
         shipped in the X-Pilosa-Tpu-Deadline header (the remote inherits
-        it) and used to clamp the socket timeout."""
+        it) and used to clamp the socket timeout.
+
+        The third return element is the peer's fragment-generation
+        summary for the index (piggybacked so the coordinator can key
+        cross-node result-cache entries; cache/results.py)."""
         headers, timeout = self._deadline_extras(deadline_s, self.timeout)
         out = self._json(host, "POST", f"/internal/query/{index}", {
             "calls": [call_to_wire(c) for c in calls],
             "shards": shards,
         }, timeout=timeout, headers=headers)
         return ([result_from_wire(r) for r in out["results"]],
-                float(out.get("execS", 0.0)))
+                float(out.get("execS", 0.0)), out.get("gens"))
 
     def send_message(self, host: str, msg: dict,
                      timeout: float | None = None):
@@ -772,6 +776,17 @@ class Cluster:
         # atomicity of single set ops (r5 advisor).
         self._remote_shards: dict[str, set[int]] = {}
         self._shards_lock = threading.Lock()
+        # Per-(index, peer) data-version registry for the coordinator-
+        # scope result cache (cache/results.py): bumped whenever this
+        # node forwards a write/import/repair to the peer, and whenever a
+        # piggybacked gen summary (on /internal/query responses and
+        # /status probes) differs from the last one seen.  Cache keys
+        # embed the versions, so a bump structurally invalidates every
+        # entry that depended on that peer's data.  _gen_lock is a leaf
+        # lock (never held across I/O).
+        self._peer_data_ver: dict[tuple[str, str], int] = {}
+        self._peer_gen_seen: dict[tuple[str, str], tuple] = {}
+        self._gen_lock = threading.Lock()
         self._load_topology()
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * len(self.nodes)))
@@ -889,6 +904,12 @@ class Cluster:
                 continue
             n.probe_fails = 0
             n.state = NODE_READY
+            # fold the probe's piggybacked gen summaries into the result-
+            # cache registry: writes that entered the cluster through
+            # OTHER nodes (never crossing this coordinator) stop matching
+            # cached entries within one health interval
+            for iname, summary in (st.get("dataGens") or {}).items():
+                self.note_peer_gens(iname, n.id, tuple(summary))
             if was_down:
                 # every pooled connection to the peer predates its
                 # outage/restart — invalidate them BEFORE any traffic
@@ -967,6 +988,42 @@ class Cluster:
         return [{"id": nid, "uri": self.by_id[nid].host}
                 for nid in self.placement.shard_nodes(index, shard)]
 
+    # -- peer data-version registry (result-cache keying) ------------------
+
+    def note_peer_write(self, index: str, node_ids):
+        """A write/import/repair was forwarded to these peers: their data
+        (from our point of view) changed — bump their versions so cached
+        cross-node results stop matching."""
+        with self._gen_lock:
+            for nid in node_ids:
+                if nid == self.node_id:
+                    continue
+                self._peer_data_ver[(index, nid)] = \
+                    self._peer_data_ver.get((index, nid), 0) + 1
+
+    def note_peer_gens(self, index: str, nid: str, summary):
+        """Fold a piggybacked gen summary (from an /internal/query
+        response or a /status probe) into the registry; cache keys embed
+        the last-seen summary, so a changed one stops every dependent
+        entry from matching."""
+        if summary is None:
+            return
+        with self._gen_lock:
+            self._peer_gen_seen[(index, nid)] = tuple(summary)
+
+    def _peer_seen_vector(self, index: str) -> tuple:
+        """Last-seen per-peer gen summaries.  At FILL time this reflects
+        the fan-out's own responses — i.e. it describes exactly the data
+        the results were computed from."""
+        with self._gen_lock:
+            return tuple((n.id, self._peer_gen_seen.get((index, n.id)))
+                         for n in self.nodes if n.id != self.node_id)
+
+    def _peer_write_vector(self, index: str) -> tuple:
+        with self._gen_lock:
+            return tuple((n.id, self._peer_data_ver.get((index, n.id), 0))
+                         for n in self.nodes if n.id != self.node_id)
+
     # -- shard discovery ---------------------------------------------------
 
     def forget_index_shards(self, index: str):
@@ -1042,6 +1099,31 @@ class Cluster:
         query = translator.translate_query(index, query)
         if shards is None:
             shards = self._available_shards(index)
+        # Coordinator-scope result cache: keyed on the NORMALIZED plan
+        # repr (post-translation), the shard set, the local fragment
+        # generation vector, and the per-peer data versions (see
+        # note_peer_write/note_peer_gens) — so local mutations, forwarded
+        # writes, and peer-reported gen changes all structurally
+        # invalidate (cache/results.py).
+        qkey = local_part = None
+        cache = self.api.executor.result_cache
+        if cache is not None and cache.limit_bytes > 0:
+            from ..core import attr_epoch, schema_epoch
+            from ..cache.results import gen_vector, query_is_readonly
+            if query_is_readonly(query):
+                qkey = ("cluster", index, repr(query), tuple(shards))
+                # local gens/epochs and the per-peer WRITE versions are
+                # captured here and reused verbatim at fill time: a write
+                # landing during the fan-out must key the entry to the
+                # PRE-write state (so it never matches again), not be
+                # masked by a post-write re-read of the counters
+                local_part = (gen_vector(self.holder, index),
+                              schema_epoch(), attr_epoch(),
+                              self._peer_write_vector(index))
+                out = cache.lookup(
+                    qkey + local_part + (self._peer_seen_vector(index),))
+                if out is not None:
+                    return out
         if len(query.calls) > 1 and \
                 all(self._batchable_read(c) for c in query.calls):
             results = self._execute_calls_batched(index, query.calls,
@@ -1055,6 +1137,15 @@ class Cluster:
         if translator.needs_translation(index):
             results = translator.translate_results(index, query.calls,
                                                    results)
+        if qkey is not None:
+            # Fill key = lookup-time local state + the peer gen summaries
+            # AS OBSERVED by this fan-out's responses.  Only the seen
+            # vector is re-read: the responses describe exactly the data
+            # the results came from (so the first warm repeat hits),
+            # while everything captured at lookup time guarantees a
+            # concurrent write's invalidation can never be overwritten.
+            cache.fill(qkey, qkey + local_part +
+                       (self._peer_seen_vector(index),), results)
         return results
 
     @classmethod
@@ -1191,11 +1282,12 @@ class Cluster:
             pending = []
             for nid, (nshards, t0, fut) in futures.items():
                 try:
-                    res, exec_s = fut.result()
+                    res, exec_s, peer_gens = fut.result()
                     elapsed = time.perf_counter() - t0
                     stats.timing("cluster.multi.peer_exec", exec_s)
                     stats.timing("cluster.multi.wire_overhead",
                                  max(elapsed - exec_s, 0.0))
+                    self.note_peer_gens(index, nid, peer_gens)
                     for i, r in enumerate(res):
                         out[i].append(r)
                 except CircuitOpenError as e:
@@ -1429,6 +1521,7 @@ class Cluster:
         shard = col // SHARD_WIDTH
         owners = self.placement.shard_nodes(index, shard)
         self._require_ready(owners, f"write shard {shard} of {index!r}")
+        self.note_peer_write(index, owners)
         futures = []
         for nid in owners:
             if nid != self.node_id:
@@ -1448,6 +1541,7 @@ class Cluster:
         involved = [n.id for n in self.nodes
                     if self.placement.owned_shards(n.id, index, shards)]
         self._require_ready(involved, f"{c.name} on {index!r}")
+        self.note_peer_write(index, involved)
         changed = False
         futures = []
         for n in self.nodes:
@@ -1472,6 +1566,7 @@ class Cluster:
         divergence a mid-fan-out failure can still leave."""
         self._require_ready([n.id for n in self.nodes],
                             f"{c.name} on {index!r}")
+        self.note_peer_write(index, [n.id for n in self.peers()])
         # local write FIRST: if it fails, no peer has diverged yet
         out = self._local_exec(index, c, [])
         futures = [self._pool.submit(self.client.query_call, n.host, index,
@@ -1586,9 +1681,11 @@ class Cluster:
                 # can happen if this node missed the create-index while
                 # down; the field implies the index
                 idx = holder.create_index_if_not_exists(msg["index"])
+            # lenient: applying a peer's schema must never crash this
+            # node — the coordinator already validated user input
             idx.create_field_if_not_exists(
                 msg["field"], FieldOptions.from_dict(
-                    msg.get("options", {})))
+                    msg.get("options", {}), lenient=True))
         elif t == "apply-schema":
             from ..storage import FieldOptions
             for idx_def in msg.get("schema", []):
@@ -1599,7 +1696,8 @@ class Cluster:
                 for fdef in idx_def.get("fields", []):
                     idx.create_field_if_not_exists(
                         fdef["name"],
-                        FieldOptions.from_dict(fdef.get("options", {})))
+                        FieldOptions.from_dict(fdef.get("options", {}),
+                                               lenient=True))
         elif t == "delete-field":
             idx = holder.index(msg["index"])
             if idx is not None:
@@ -1634,6 +1732,9 @@ class Cluster:
             for nid in owners:
                 by_node.setdefault(nid, []).append(int(s))
         idx = self.holder.index(index)
+        # forwarded imports mutate the owners' data: invalidate cached
+        # cross-node results that depended on them
+        self.note_peer_write(index, by_node)
         futures = []
         local_payload = None
         for nid, nshards in by_node.items():
@@ -1678,6 +1779,7 @@ class Cluster:
     def import_roaring(self, index: str, field: str, shard: int,
                        views: dict[str, bytes], clear: bool):
         """Forward a pre-serialized roaring import to each shard owner."""
+        self.note_peer_write(index, self.placement.shard_nodes(index, shard))
         for nid in self.placement.shard_nodes(index, shard):
             if nid == self.node_id:
                 self.api.apply_import_roaring_local(index, field, shard,
@@ -1855,6 +1957,7 @@ class Cluster:
                 self.client.block_repair(
                     host, index, field, view, shard,
                     decode(p_sets), decode(p_clears))
+                self.note_peer_write(index, [nid])
             except Exception:
                 continue  # peer repair is best-effort; next pass retries
 
@@ -2339,6 +2442,7 @@ class Cluster:
         cluster = self
 
         def internal_query(req, args):
+            from ..cache.results import gen_summary
             body = req.json()
             shards = body.get("shards")
             if "calls" in body:
@@ -2348,7 +2452,12 @@ class Cluster:
                     args["index"], Query(calls), shards or [],
                     translate=False)
                 return {"results": [result_to_wire(r) for r in res],
-                        "execS": time.perf_counter() - t0}
+                        "execS": time.perf_counter() - t0,
+                        # post-execution gen summary: lets the coordinator
+                        # key its cross-node result-cache entries to the
+                        # data this answer was computed from
+                        "gens": list(gen_summary(cluster.holder,
+                                                 args["index"]))}
             call = call_from_wire(body["call"])
             result = cluster._local_exec(args["index"], call, shards or [])
             return {"result": result_to_wire(result)}

@@ -93,6 +93,14 @@ class Config:
     # Failpoint spec armed at startup (utils/faults.py syntax); empty =
     # nothing armed.  For chaos tests and game-days only.
     failpoints: str = ""
+    # -- query cache subsystem (docs/caching.md) ---------------------------
+    # Host-byte budget for the generation-keyed result cache (LRU); 0
+    # disables it.  Off by default so chaos/overload exercises hit the
+    # real execution path; production serving wants it on (e.g. 256).
+    result_cache_mb: int = 0
+    # Distinct rows a batched write may touch before a fragment's rank
+    # cache stops updating incrementally and rebuilds lazily instead.
+    rank_rebuild_rows: int = 4096
     verbose: bool = False
 
     @classmethod
@@ -140,6 +148,8 @@ class Config:
             "PILOSA_TPU_HEALTH_DOWN_THRESHOLD": ("health_down_threshold",
                                                  int),
             "PILOSA_TPU_FAILPOINTS": ("failpoints", str),
+            "PILOSA_TPU_RESULT_CACHE_MB": ("result_cache_mb", int),
+            "PILOSA_TPU_RANK_REBUILD_ROWS": ("rank_rebuild_rows", int),
         }
         for env, (attr, conv) in env_map.items():
             if env in os.environ:
@@ -173,6 +183,8 @@ class Config:
             "drain-seconds": "drain_seconds",
             "health-down-threshold": "health_down_threshold",
             "failpoints": "failpoints",
+            "result-cache-mb": "result_cache_mb",
+            "rank-rebuild-rows": "rank_rebuild_rows",
         }
         for key, attr in mapping.items():
             if key in doc:
@@ -245,6 +257,14 @@ class Server:
                     self.cluster.remote_translate_factory
         self.api = API(self.holder, cluster=self.cluster, stats=self.stats,
                        use_mesh=self.config.use_mesh)
+        # query cache subsystem (docs/caching.md): byte budget for the
+        # result cache; the rank-rebuild threshold is process-wide like
+        # the memory budgets (most recent Server's config wins)
+        self.api.executor.result_cache.limit_bytes = \
+            max(self.config.result_cache_mb, 0) << 20
+        from .. import cache as _cache_pkg
+        _cache_pkg.rank.RANK_REBUILD_ROWS = max(
+            self.config.rank_rebuild_rows, 0)
         host, port = self._parse_bind(self.config.bind)
         tls = None
         if self.config.tls_certificate and self.config.tls_key:

@@ -46,6 +46,7 @@ class AttrStore:
     def set_attrs(self, id_: int, attrs: dict):
         """Merge semantics; a None value deletes the key
         (attr.go SetAttrs)."""
+        from ..core import bump_attr_epoch
         with self._lock:
             cur = self._attrs.setdefault(id_, {})
             for k, v in attrs.items():
@@ -56,13 +57,16 @@ class AttrStore:
             if not cur:
                 self._attrs.pop(id_, None)
             self._save()
+        bump_attr_epoch()
 
     def set_bulk_attrs(self, items: dict[int, dict]):
+        from ..core import bump_attr_epoch
         with self._lock:
             for id_, attrs in items.items():
                 cur = self._attrs.setdefault(id_, {})
                 cur.update({k: v for k, v in attrs.items() if v is not None})
             self._save()
+        bump_attr_epoch()
 
     def all(self) -> dict[int, dict]:
         with self._lock:

@@ -82,6 +82,20 @@ class FieldOptions:
     keys: bool = False
 
     def __post_init__(self):
+        # Reject bad cache options AT FIELD CREATION (a 400 through the
+        # API) instead of silently persisting an arbitrary cacheType
+        # string into the schema where every later TopN would have to
+        # guess at it (field.go:1462 validates the same way).
+        if self.cache_type not in (CACHE_TYPE_RANKED, CACHE_TYPE_LRU,
+                                   CACHE_TYPE_NONE):
+            raise FieldError(
+                f"invalid cacheType {self.cache_type!r} (expected one of "
+                f"'ranked', 'lru', 'none')")
+        if not isinstance(self.cache_size, int) \
+                or isinstance(self.cache_size, bool) or self.cache_size < 0:
+            raise FieldError(
+                f"invalid cacheSize {self.cache_size!r} (expected a "
+                f"non-negative integer)")
         if self.type == FIELD_TYPE_INT:
             # Magnitude is stored sign+magnitude in 63 BSI rows, so the
             # representable floor is -(2^63-1), not MinInt64; defaulting to
@@ -110,11 +124,27 @@ class FieldOptions:
         }
 
     @classmethod
-    def from_dict(cls, d: dict) -> "FieldOptions":
+    def from_dict(cls, d: dict, lenient: bool = False) -> "FieldOptions":
+        """``lenient=True`` for the DISK LOAD path: schemas persisted
+        before cache-option validation existed may carry arbitrary
+        cacheType strings / bad sizes, and a node must not refuse to
+        start over them.  Unknown types coerce to 'none' — exactly the
+        pre-validation behavior, where an unrecognized cacheType meant no
+        cache was ever consulted.  API field creation stays strict
+        (400)."""
+        cache_type = d.get("cacheType", CACHE_TYPE_RANKED)
+        cache_size = d.get("cacheSize", DEFAULT_CACHE_SIZE)
+        if lenient:
+            if cache_type not in (CACHE_TYPE_RANKED, CACHE_TYPE_LRU,
+                                  CACHE_TYPE_NONE):
+                cache_type = CACHE_TYPE_NONE
+            if not isinstance(cache_size, int) \
+                    or isinstance(cache_size, bool) or cache_size < 0:
+                cache_size = DEFAULT_CACHE_SIZE
         return cls(
             type=d.get("type", FIELD_TYPE_SET),
-            cache_type=d.get("cacheType", CACHE_TYPE_RANKED),
-            cache_size=d.get("cacheSize", DEFAULT_CACHE_SIZE),
+            cache_type=cache_type,
+            cache_size=cache_size,
             min=d.get("min"),
             max=d.get("max"),
             base=d.get("base", 0),
@@ -174,7 +204,8 @@ class Field:
             return
         if os.path.exists(self._meta_path()):
             with open(self._meta_path()) as f:
-                self.options = FieldOptions.from_dict(json.load(f))
+                self.options = FieldOptions.from_dict(json.load(f),
+                                                      lenient=True)
         views_dir = os.path.join(self.path, "views")
         if os.path.isdir(views_dir):
             for vname in os.listdir(views_dir):
@@ -215,7 +246,9 @@ class Field:
                 if self.path is not None:
                     vpath = os.path.join(self.path, "views", name)
                 v = View(vpath, self.index, self.name, name,
-                         max_op_n=self.max_op_n, row_id_cap=self.row_id_cap)
+                         max_op_n=self.max_op_n, row_id_cap=self.row_id_cap,
+                         cache_type=self.options.cache_type,
+                         cache_size=self.options.cache_size)
                 self.views[name] = v
             return v
 

@@ -136,6 +136,12 @@ class Fragment:
         # mirrors alive (and a recreated fragment can never alias a stale
         # cache entry).
         self.gen = next(self._GEN)
+        # Per-fragment rank cache (cache/rank.py RankCache), attached by
+        # the owning View for fields with cacheType ranked/lru; None for
+        # cacheType none, BSI views, and bare test fragments.  Maintained
+        # incrementally by the mutators below via _note_rank /
+        # _rank_invalidate.
+        self.rank_cache = None
         # host-side dense staging cache: (gen, dense block) — see
         # staged_dense()
         self._stage = None
@@ -310,6 +316,18 @@ class Fragment:
         self._dirty_data = True
         self.gen = next(self._GEN)
 
+    def _note_rank(self, rows):
+        """Incremental rank-cache maintenance after a successful mutation
+        touching ``rows`` (called under self._lock)."""
+        if self.rank_cache is not None:
+            self.rank_cache.note_write(self, rows)
+
+    def _rank_invalidate(self):
+        """Bulk mutation whose touched rows aren't cheaply known (row
+        stores, mutex imports): rebuild the rank cache lazily."""
+        if self.rank_cache is not None:
+            self.rank_cache.invalidate()
+
     # -- sparse store primitives -------------------------------------------
 
     def _locate(self, nidx: np.ndarray):
@@ -442,6 +460,7 @@ class Fragment:
                                        np.asarray([col], dtype=np.int64),
                                        clear=False) > 0
             if changed:
+                self._note_rank([row])
                 self._log_op(_OP_SET, row, col)
             return changed
 
@@ -451,6 +470,7 @@ class Fragment:
                                        np.asarray([col], dtype=np.int64),
                                        clear=True) > 0
             if changed:
+                self._note_rank([row])
                 self._log_op(_OP_CLEAR, row, col)
             return changed
 
@@ -466,6 +486,7 @@ class Fragment:
         with self._lock:
             n_changed = self._apply_bits(rows, cols, clear=clear)
             if n_changed:
+                self._note_rank(rows)
                 self._log_ops(_OP_CLEAR if clear else _OP_SET, rows, cols)
             return n_changed
 
@@ -498,6 +519,7 @@ class Fragment:
             set_changed = self._apply_bits(urow, ucols, clear=False)
             n_changed = cleared + set_changed - 2 * pre_winner
             if n_changed:
+                self._rank_invalidate()  # cleared rows aren't enumerated
                 self._mark_device_dirty()
                 if self._wal_file is not None:
                     self.snapshot()
@@ -521,6 +543,7 @@ class Fragment:
                 nz = np.nonzero(seg)[0]
                 if nz.size:
                     self._or_words(base + nz.astype(np.int64), seg[nz])
+            self._note_rank([row])
             self._mark_device_dirty()
             self.snapshot()  # row stores bypass the op log
 
@@ -627,6 +650,37 @@ class Fragment:
             bit = np.uint32(1 << (col & 31))
             sel = (self._idx % SHARD_WORDS == w) & (self._val & bit > 0)
             return (self._idx[sel] // SHARD_WORDS).astype(np.int64)
+
+    def row_counts_host(self, rows: np.ndarray) -> np.ndarray:
+        """Exact per-row set-bit counts for the given rows, from the host
+        sparse store (no device touch).  Popcounts only each requested
+        row's word range (O(log nnz) locate + O(row words) per row) —
+        this runs on EVERY single-bit write of a rank-cached field, so a
+        whole-store scan here would make writes O(nnz)."""
+        rows = np.asarray(rows, dtype=np.int64)
+        with self._lock:
+            out = np.zeros(rows.size, dtype=np.int64)
+            if self._idx.size == 0 or rows.size == 0:
+                return out
+            a = np.searchsorted(self._idx, rows * SHARD_WORDS)
+            b = np.searchsorted(self._idx, (rows + 1) * SHARD_WORDS)
+            for i in range(rows.size):
+                if b[i] > a[i]:
+                    out[i] = int(np.bitwise_count(
+                        self._val[a[i]: b[i]]).sum())
+            return out
+
+    def row_counts_all_host(self) -> tuple[np.ndarray, np.ndarray]:
+        """(row ids, exact counts) of every row with any set bit, from the
+        host sparse store — the rank-cache rebuild scan (O(nnz))."""
+        with self._lock:
+            if self._idx.size == 0:
+                z = np.zeros(0, dtype=np.int64)
+                return z, z
+            rows_of = self._idx // SHARD_WORDS
+            pops = np.bitwise_count(self._val).astype(np.int64)
+            uniq, start = np.unique(rows_of, return_index=True)
+            return uniq, np.add.reduceat(pops, start)
 
     def pairs(self) -> tuple[np.ndarray, np.ndarray]:
         """(rows, shard-local cols) of every set bit, (row, col)-ordered —

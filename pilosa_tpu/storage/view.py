@@ -9,19 +9,30 @@ from __future__ import annotations
 import os
 import threading
 
+from ..core import VIEW_STANDARD
 from .fragment import Fragment
 
 
 class View:
     def __init__(self, path: str | None, index: str, field: str, name: str,
                  max_op_n: int | None = None,
-                 row_id_cap: int | None = None):
+                 row_id_cap: int | None = None,
+                 cache_type: str | None = None, cache_size: int = 0):
+        """``cache_type``/``cache_size``: the owning field's rank-cache
+        options (field.go cacheType/cacheSize), threaded down so the
+        STANDARD view's fragments of a ranked/lru field get a RankCache
+        attached.  Time and BSI views never cache — TopN pruning reads
+        only the standard view (and BSI rows are bit slices, not rank
+        candidates; the reference likewise forces CacheTypeNone on int
+        fields)."""
         self.path = path
         self.index = index
         self.field = field
         self.name = name
         self.max_op_n = max_op_n
         self.row_id_cap = row_id_cap
+        self.cache_type = cache_type
+        self.cache_size = cache_size
         self.fragments: dict[int, Fragment] = {}
         self._lock = threading.RLock()
 
@@ -41,6 +52,15 @@ class View:
                     kwargs["max_op_n"] = self.max_op_n
                 frag = Fragment(frag_path, self.index, self.field, self.name,
                                 shard, row_id_cap=self.row_id_cap, **kwargs)
+                # Only the STANDARD view caches: TopN candidate pruning
+                # reads exclusively from it (cache/rank.topn_from_rank),
+                # so rank maintenance on time/BSI views would be pure
+                # write-path overhead with no reader.
+                if self.cache_type in ("ranked", "lru") and \
+                        self.name == VIEW_STANDARD:
+                    from ..cache.rank import RankCache
+                    frag.rank_cache = RankCache(self.cache_type,
+                                                self.cache_size)
                 self.fragments[shard] = frag
             return frag
 
