@@ -698,6 +698,184 @@ def bench_http(server_port, rng, n_rows):
     return (B * n_batches) / (time.perf_counter() - t0)
 
 
+def _http_count_load(port, index, field, n_rows, rng, threads,
+                     per_thread=120):
+    """Drive ``threads`` keep-alive clients of SINGLE small Count queries
+    (one query per POST — the serving shape cross-query dynamic batching
+    exists for; distinct literals defeat the tunnel's (executable, args)
+    memoization).  Returns (qps, p50_s)."""
+    import http.client
+    import threading
+
+    local = threading.local()
+
+    def post(body: bytes):
+        conn = getattr(local, "conn", None)
+        if conn is None:
+            conn = local.conn = http.client.HTTPConnection(
+                "localhost", port, timeout=120)
+        try:
+            conn.request("POST", f"/index/{index}/query", body=body)
+            resp = conn.getresponse()
+            data = resp.read()
+        except (OSError, http.client.HTTPException):
+            conn.close()
+            local.conn = None
+            raise
+        assert resp.status == 200, data
+        return data
+
+    rows = rng.integers(0, n_rows, size=threads * per_thread)
+    lats: list[float] = []
+    lock = threading.Lock()
+
+    def worker(k):
+        mine = []
+        for i in range(k * per_thread, (k + 1) * per_thread):
+            t1 = time.perf_counter()
+            post(f"Count(Row({field}={rows[i]}))".encode())
+            mine.append(time.perf_counter() - t1)
+        with lock:
+            lats.extend(mine)
+
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=worker, args=(k,))
+          for k in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.perf_counter() - t0
+    return threads * per_thread / dt, float(np.median(lats))
+
+
+def bench_http_dynamic_batching(holder, executor, meta, rng):
+    """Concurrent-HTTP dynamic-batching config (docs/batching.md): 16
+    client threads of small single-Count queries through the REAL server,
+    ``dispatch-batch`` on vs off, plus single-client p50 both ways (the
+    acceptance criteria: >=4x qps at 16 threads, solo p50 within 10%).
+    Reports the on-server's batch-size histogram and window-wait
+    percentiles from /debug/vars."""
+    import tempfile
+    import urllib.request
+
+    from pilosa_tpu.executor import Executor as _Ex
+    from pilosa_tpu.server import Config, Server
+
+    n_rows = meta["star_rows"]
+    out = {}
+    for mode, ex in (("on", executor),
+                     ("off", _Ex(holder, use_mesh=True,
+                                 dispatch_batch=False))):
+        srv = Server(Config(
+            data_dir=tempfile.mkdtemp(prefix=f"ptpu_dynb_{mode}_"),
+            bind="localhost:0", anti_entropy_interval=0,
+            dispatch_batch=(mode == "on")))
+        try:
+            srv.holder.indexes = holder.indexes
+            srv.api.holder = holder
+            srv.api.executor = ex
+            srv.open()
+            # warm: compile the padded fused query-axis shapes before
+            # the timed window so XLA compiles don't pollute it
+            _http_count_load(srv.port, "startrace", "stargazer", n_rows,
+                             rng, 16, per_thread=20)
+            (qps, _), spread = best_of(lambda: _http_count_load(
+                srv.port, "startrace", "stargazer", n_rows, rng, 16))
+            (solo_qps, solo_p50), _ = best_of(lambda: _http_count_load(
+                srv.port, "startrace", "stargazer", n_rows, rng, 1,
+                per_thread=64))
+            out[f"qps_{mode}"] = round(qps, 1)
+            out[f"spread_{mode}"] = spread
+            out[f"solo_p50_ms_{mode}"] = round(solo_p50 * 1e3, 3)
+            if mode == "on":
+                with urllib.request.urlopen(
+                        f"http://localhost:{srv.port}/debug/vars",
+                        timeout=30) as resp:
+                    snap = json.loads(resp.read())
+                b = snap.get("dispatchBatcher", {})
+                out["batch_size_hist"] = b.get("batchSize")
+                out["window_wait"] = b.get("windowWaitS")
+                out["fused_launches"] = b.get("fusedLaunches")
+        finally:
+            srv.httpd.shutdown()
+            if mode == "off":
+                ex.close()
+    out["speedup"] = round(out["qps_on"] / out["qps_off"], 2) \
+        if out.get("qps_off") else None
+    return out
+
+
+def run_http_batch_smoke(rng) -> dict:
+    """Dynamic-batching leg of --smoke (docs/batching.md): 16 concurrent
+    HTTP clients of small single-Count queries against a real server with
+    ``dispatch-batch`` on, then off — asserting the on-mode actually
+    fused launches and both modes agree on a sample answer.  The >=4x
+    qps acceptance floor is a device-dispatch-floor effect and is judged
+    by the full bench on real hardware, not this CPU smoke."""
+    import tempfile
+    import urllib.request
+
+    from pilosa_tpu.core import SHARD_WIDTH
+    from pilosa_tpu.server.server import Config, Server
+
+    out = {}
+    want = None
+    # one dataset for BOTH modes (the rng advances per draw — sampling
+    # inside the loop would hand each server different bits and void the
+    # answer comparison)
+    cols = rng.integers(0, SHARD_WIDTH, size=20_000)
+    rws = rng.integers(0, 64, size=20_000)
+    for mode in ("on", "off"):
+        srv = Server(Config(
+            data_dir=tempfile.mkdtemp(prefix=f"ptpu_smkb_{mode}_"),
+            bind="localhost:0", anti_entropy_interval=0,
+            dispatch_batch=(mode == "on"),
+            dispatch_batch_window_us=1000))
+        try:
+            srv.open()
+
+            def post(path, body):
+                req = urllib.request.Request(
+                    f"http://localhost:{srv.port}{path}", method="POST",
+                    data=body.encode())
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return resp.read()
+
+            post("/index/dynb", "{}")
+            post("/index/dynb/field/f", "{}")
+            post("/index/dynb/field/f/import", json.dumps(
+                {"rowIDs": rws.tolist(), "columnIDs": cols.tolist()}))
+            got = json.loads(post("/index/dynb/query",
+                                  "Count(Row(f=7))"))["results"]
+            if want is None:
+                want = got
+            assert got == want, f"batched answer diverged: {got} != {want}"
+            _http_count_load(srv.port, "dynb", "f", 64, rng, 16,
+                             per_thread=8)  # warm compiles
+            qps, p50 = _http_count_load(srv.port, "dynb", "f", 64, rng,
+                                        16, per_thread=32)
+            out[f"qps_{mode}"] = round(qps, 1)
+            out[f"p50_ms_{mode}"] = round(p50 * 1e3, 2)
+            if mode == "on":
+                with urllib.request.urlopen(
+                        f"http://localhost:{srv.port}/debug/vars",
+                        timeout=30) as resp:
+                    snap = json.loads(resp.read())
+                b = snap["dispatchBatcher"]
+                assert b["fusedLaunches"] > 0, \
+                    "16 concurrent clients never produced a fused launch"
+                out["fused_launches"] = b["fusedLaunches"]
+                out["batch_size_hist"] = b["batchSize"]
+                out["window_wait"] = b["windowWaitS"]
+                out["client_aborts"] = snap["counts"].get(
+                    "http.client_abort", 0)
+        finally:
+            srv.close()
+    out["speedup"] = round(out["qps_on"] / out["qps_off"], 2)
+    return out
+
+
 def _smoke_norm(results):
     """TopN results -> comparable (id, count) lists."""
     return [[(p.id, p.count) for p in r] for r in results]
@@ -908,6 +1086,7 @@ def run_smoke():
         ex5.close()
     out["cache"] = run_cache_smoke(np.random.default_rng(SEED + 3))
     out["overload"] = run_overload_smoke()
+    out["http_batch"] = run_http_batch_smoke(np.random.default_rng(SEED + 4))
     out["total_s"] = round(time.perf_counter() - t_start, 2)
     print(json.dumps(out))
 
@@ -958,6 +1137,18 @@ def main():
         print(f"config 5d failed: {e!r}", file=sys.stderr)
         traceback.print_exc()
         cfg5d = None
+
+    # concurrent-HTTP dynamic-batching config (docs/batching.md): the
+    # served single-query path, dispatch-batch on vs off
+    try:
+        http_batch = bench_http_dynamic_batching(holder, executor, meta,
+                                                 rng)
+    except Exception as e:
+        import traceback
+        print(f"http dynamic-batching config failed: {e!r}",
+              file=sys.stderr)
+        traceback.print_exc()
+        http_batch = None
 
     # HTTP variant (engine behind the real server)
     http_qps = None
@@ -1011,6 +1202,8 @@ def main():
         configs["5d_intersect_topn_4node_cluster"] = cfg5d
     if http_qps:
         configs["2_http_path"] = {"qps": round(http_qps, 1)}
+    if http_batch:
+        configs["6_http_dynamic_batching"] = http_batch
 
     print(json.dumps({
         "metric": "engine_intersect8_count_qps_1M_cols",

@@ -56,17 +56,23 @@ class DisallowedError(ApiError):
 
 class API:
     def __init__(self, holder: Holder, cluster=None, stats=None,
-                 use_mesh: bool = True):
+                 use_mesh: bool = True, dispatch_batch: bool = True,
+                 dispatch_batch_max: int = 32,
+                 dispatch_batch_window_us: float = 200.0):
         """``use_mesh=True`` (the default, config-gated by the server)
         executes served queries over the device mesh — stacked shard
         batches under shard_map with ICI reductions — the production
         equivalent of the reference's worker pool + mapReduce
-        (executor.go:80-110, 2455)."""
+        (executor.go:80-110, 2455).  ``dispatch_batch*``: cross-query
+        dynamic batching of device dispatch (docs/batching.md)."""
         self.holder = holder
         self.cluster = cluster  # None = single-node
         self.stats = stats if stats is not None else StatsClient()
-        self.executor = Executor(holder, use_mesh=use_mesh,
-                                 stats=self.stats)
+        self.executor = Executor(
+            holder, use_mesh=use_mesh, stats=self.stats,
+            dispatch_batch=dispatch_batch,
+            dispatch_batch_max=dispatch_batch_max,
+            dispatch_batch_window_us=dispatch_batch_window_us)
         self._lock = threading.RLock()
 
     # -- state validation (api.go:119) -------------------------------------
@@ -392,10 +398,22 @@ class API:
 
     def recalculate_caches(self):
         """(api.go RecalculateCaches): eagerly rebuild every fragment's
-        rank cache so the next TopN doesn't pay the lazy rebuild."""
+        rank cache so the next TopN doesn't pay the lazy rebuild.
+
+        Rebuilds run as BACKGROUND work through the dispatch batcher
+        (docs/batching.md): between fragments the loop yields while
+        foreground tickets are queued, so a holder-wide recalculation
+        can't starve live queries of the dispatcher (or the GIL) while
+        it walks every fragment's sparse store."""
         self._validate("RecalculateCaches")
         from .cache.rank import iter_rank_caches
-        for frag, cache in iter_rank_caches(self.holder):
-            with frag._lock:
-                cache.build(frag)
+        from contextlib import nullcontext
+        batcher = self.executor.batcher
+        bg = batcher.background() if batcher is not None else nullcontext()
+        with bg:
+            for frag, cache in iter_rank_caches(self.holder):
+                if batcher is not None:
+                    batcher.yield_to_foreground()
+                with frag._lock:
+                    cache.build(frag)
         return None

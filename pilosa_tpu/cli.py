@@ -217,6 +217,10 @@ data-dir = "~/.pilosa_tpu"
 bind = "localhost:10101"
 max-op-n = 10000
 # max-body-mb = 1024
+# cross-query dynamic batching (docs/batching.md)
+# dispatch-batch = true         # fuse compatible in-flight queries
+# dispatch-batch-max = 32       # queries per fused device launch
+# dispatch-batch-window-us = 200  # max solo wait for batch company
 # query cache subsystem (docs/caching.md)
 # result-cache-mb = 256    # generation-keyed result cache budget, 0 = off
 # rank-rebuild-rows = 4096 # incremental rank-cache ceiling per batch
@@ -254,6 +258,9 @@ def cmd_config(args) -> int:
     print(f"max-op-n = {cfg.max_op_n}")
     print(f"max-row-id = {cfg.max_row_id}")
     print(f"use-mesh = {str(cfg.use_mesh).lower()}")
+    print(f"dispatch-batch = {str(cfg.dispatch_batch).lower()}")
+    print(f"dispatch-batch-max = {cfg.dispatch_batch_max}")
+    print(f"dispatch-batch-window-us = {cfg.dispatch_batch_window_us}")
     print(f"device-budget-mb = {cfg.device_budget_mb}")
     print(f"max-body-mb = {cfg.max_body_mb}")
     print(f"result-cache-mb = {cfg.result_cache_mb}")

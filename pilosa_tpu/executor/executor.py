@@ -138,7 +138,7 @@ def _group_key_list(mesh, kind, slotted, extra):
     return mesh.batch_keys((extra["field"], extra["view"]), slotted)
 
 
-def _run_batched_groups(mesh, holder, index, shards, groups, results):
+def _run_batched_groups(batcher, holder, index, shards, groups, results):
     """Dispatch batched call groups chunk-wise and fill ``results``.
 
     ``groups``: iterable of (kind, slotted, params_mat, call_idxs, extra);
@@ -146,6 +146,11 @@ def _run_batched_groups(mesh, holder, index, shards, groups, results):
     field/view/ids_n with one (ids, n) pair per call.  Shared by the
     classic grouped path and the prepared-statement cache so the chunking
     policy lives in exactly one place.
+
+    Dispatch flows through the cross-query batcher
+    (parallel/batcher.py): on the common single-slice schedule each
+    chunk becomes a ticket, so concurrent queries replaying the same
+    prepared template fuse into one device launch.
 
     Dispatch order is SLICE-MAJOR over one residency-aware shard schedule
     covering the whole batch: every group's every chunk runs against a
@@ -158,6 +163,7 @@ def _run_batched_groups(mesh, holder, index, shards, groups, results):
     groups = list(groups)
     if not groups:
         return
+    mesh = batcher.mesh
 
     key_lists: list = []
     for kind, slotted, _pm, _ci, extra in groups:
@@ -168,19 +174,24 @@ def _run_batched_groups(mesh, holder, index, shards, groups, results):
     # chunk layout must be identical across slices so per-chunk parts can
     # accumulate; size by the largest slice (conservative for the rest)
     per_dev = mesh.stacked_per_device(sched.max_slice_len)
+    # multi-slice (over-budget) schedules keep the direct slice-major
+    # dispatch; batching a streamed working set would re-stage it whole
+    fuse = len(sched.slices) == 1
 
     def _n_split(kind, slotted):
         # count plans always gather per-row temps; sum/topn without a
         # filter broadcast one pass — single chunk (see _batch_chunks)
         return per_dev if (kind == "count" or slotted is not None) else 0
 
-    # chunk layouts (and their padded device params) computed ONCE:
-    # slice-major iteration would otherwise repeat the concatenate
-    # padding and the host->device params transfer per slice on
-    # identical data
+    # chunk layouts computed ONCE; on the multi-slice direct path the
+    # padded params also go to device once (slice-major iteration would
+    # otherwise repeat the concatenate padding and the host->device
+    # params transfer per slice on identical data) — fused tickets stay
+    # host-side so the batcher can concatenate them across queries
     import jax.numpy as jnp
     group_chunks = [
-        [(lo, n_c, jnp.asarray(sub)) for lo, n_c, sub in
+        [(lo, n_c, sub if fuse else jnp.asarray(sub))
+         for lo, n_c, sub in
          _batch_chunks(params_mat, _n_split(kind, slotted))]
         for kind, slotted, params_mat, _ci, extra in groups]
 
@@ -190,16 +201,17 @@ def _run_batched_groups(mesh, holder, index, shards, groups, results):
                 in enumerate(groups):
             for lo, _n, sub in group_chunks[gi]:
                 if kind == "count":
-                    parts = mesh.count_batch_async(
-                        slotted, sub, holder, index, shard_slice)
+                    parts = batcher.count_batch(
+                        slotted, sub, holder, index, shard_slice,
+                        fuse=fuse)
                 elif kind == "sum":
-                    parts = mesh.bsi_sum_batch_async(
+                    parts = batcher.bsi_sum_batch(
                         extra["field"], extra["view"], slotted, sub,
-                        holder, index, shard_slice)
+                        holder, index, shard_slice, fuse=fuse)
                 else:  # topn
-                    parts = mesh.row_counts_batch_async(
+                    parts = batcher.row_counts_batch(
                         extra["field"], extra["view"], slotted, sub,
-                        holder, index, shard_slice)
+                        holder, index, shard_slice, fuse=fuse)
                 parts_acc.setdefault((gi, lo), []).extend(parts)
 
     # all parts dispatched; build the pendings (finalizers sum/merge the
@@ -294,14 +306,19 @@ def _resolve_pendings(results):
 
 class Executor:
     def __init__(self, holder, mesh=None, use_mesh: bool | None = None,
-                 stats=None):
+                 stats=None, dispatch_batch: bool = True,
+                 dispatch_batch_max: int = 32,
+                 dispatch_batch_window_us: float = 200.0):
         """``mesh``: a jax Mesh to execute shard batches on (stacked
         shard_map execution with ICI reductions, parallel/mesh_exec.py).
         When None, per-shard dispatch is used.  ``use_mesh=True`` with no
         mesh builds one over all local devices.  ``stats``: a StatsClient
         for per-phase timings (parse/translate/dispatch/fetch) and cache
         counters, surfaced at /debug/vars (the instrumentation sites of
-        executor.go:295-336)."""
+        executor.go:295-336).  ``dispatch_batch*``: cross-query dynamic
+        batching of device dispatch (parallel/batcher.py,
+        docs/batching.md) — with it off, the batcher still fronts every
+        mesh dispatch but delegates directly."""
         self.holder = holder
         self.compiler = PlanCompiler()
         from ..utils.stats import NopStatsClient
@@ -316,14 +333,22 @@ class Executor:
         from ..cache.results import ResultCache
         self.result_cache = ResultCache(stats=self.stats)
         self.mesh_exec = None
+        self.batcher = None
         self.prepared = None
         if mesh is not None or use_mesh:
+            from ..parallel.batcher import DispatchBatcher
             from ..parallel.mesh_exec import MeshExecutor
             from .prepared import PreparedCache
             self.mesh_exec = MeshExecutor(mesh)
+            self.batcher = DispatchBatcher(
+                self.mesh_exec, enabled=dispatch_batch,
+                max_batch=dispatch_batch_max,
+                window_us=dispatch_batch_window_us, stats=self.stats)
             self.prepared = PreparedCache(self)
 
     def close(self):
+        if self.batcher is not None:
+            self.batcher.close()
         if self.mesh_exec is not None:
             self.mesh_exec.close()
 
@@ -511,7 +536,7 @@ class Executor:
         # ONE invocation for every group: they share one residency-aware
         # shard schedule, so under budget pressure the whole multi-group
         # batch drains against each shard slice before the budget rotates
-        _run_batched_groups(self.mesh_exec, self.holder, index, shards,
+        _run_batched_groups(self.batcher, self.holder, index, shards,
                             to_run, results)
 
         for i, c in enumerate(calls):
@@ -575,7 +600,8 @@ class Executor:
 
     def _plan_segments(self, plan, index: str, shards) -> dict:
         if self.mesh_exec is not None:
-            return self.mesh_exec.segments(plan, self.holder, index, shards)
+            return self.batcher.segments(plan, self.holder, index,
+                                         shards)
         return {
             shard: self.compiler.execute_shard(plan, self.holder, index,
                                                shard)
@@ -590,8 +616,8 @@ class Executor:
             raise ExecutionError("Count() requires one input")
         plan = self._resolve(index, c.children[0])
         if self.mesh_exec is not None:
-            parts = self.mesh_exec.count_async(plan, self.holder, index,
-                                               shards)
+            parts = self.batcher.count_async(plan, self.holder, index,
+                                             shards)
             return _Pending(parts, lambda hp: sum(int(x) for x in hp))
         counts = [
             self.compiler.execute_shard(plan, self.holder, index, shard,
@@ -634,7 +660,7 @@ class Executor:
         f = self._bsi_field(index, c)
         view = f.bsi_view_name()
         if self.mesh_exec is not None:
-            parts = self.mesh_exec.bsi_sum_async(
+            parts = self.batcher.bsi_sum_async(
                 f.name, view, self._filter_plan(index, c), self.holder,
                 index, shards)
 
@@ -669,7 +695,7 @@ class Executor:
         view = f.bsi_view_name()
         acc = ValCount()
         if self.mesh_exec is not None:
-            per_shard = self.mesh_exec.bsi_min_max(
+            per_shard = self.batcher.bsi_min_max(
                 f.name, view, self._filter_plan(index, c), self.holder,
                 index, shards, want_max=want_max)
             for val, cnt in per_shard:
@@ -701,7 +727,7 @@ class Executor:
         if f is None:
             raise ExecutionError(f"field not found: {field_name}")
         if self.mesh_exec is not None:
-            counts = self.mesh_exec.row_counts(
+            counts = self.batcher.row_counts(
                 field_name, VIEW_STANDARD, None, self.holder, index, shards)
             nz = np.nonzero(counts)[0]
             if nz.size == 0:
@@ -787,15 +813,15 @@ class Executor:
             # unfiltered pass + the src count, all dispatched before the
             # single blocking fetch
             filter_plan = self._filter_plan(index, c)
-            parts = self.mesh_exec.row_counts_async(
+            parts = self.batcher.row_counts_async(
                 field_name, VIEW_STANDARD, filter_plan,
                 self.holder, index, shards)
             parts_u, parts_src = [], []
             if tan_thresh:
-                parts_u = self.mesh_exec.row_counts_async(
+                parts_u = self.batcher.row_counts_async(
                     field_name, VIEW_STANDARD, None, self.holder, index,
                     shards)
-                parts_src = self.mesh_exec.count_async(
+                parts_src = self.batcher.count_async(
                     filter_plan, self.holder, index, shards)
             k, ku = len(parts), len(parts_u)
 
@@ -872,7 +898,7 @@ class Executor:
             if v is None:
                 continue
             if self.mesh_exec is not None and column is None:
-                counts = self.mesh_exec.row_counts(
+                counts = self.batcher.row_counts(
                     field_name, vname, None, self.holder, index, shards)
                 row_ids.update(int(i) for i in np.nonzero(counts)[0])
                 continue
@@ -1016,7 +1042,7 @@ class Executor:
             # (vmapped combo axis, chunked to bound device memory) — the
             # odometer's per-combo round trips (executor.go:3058) collapse
             # into one dispatch per 256 combos, resolved by a single fetch
-            chunked = self.mesh_exec.group_counts_batch_async(
+            chunked = self.batcher.group_counts_batch_async(
                 (last_field, VIEW_STANDARD), prefix_keys, mat, filter_plan,
                 self.holder, index, shards)
             all_parts = [p for _, _, ps in chunked for p in ps]

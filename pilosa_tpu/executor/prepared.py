@@ -192,7 +192,10 @@ class PreparedEntry:
 
     def run(self, ex, index: str, values: np.ndarray, shards):
         """Dispatch all groups, then resolve with one device fetch.
-        Returns the results list, in call order."""
+        Returns the results list, in call order.  Dispatch rides the
+        cross-query batcher (parallel/batcher.py): concurrent requests
+        replaying the same template fuse into one device launch — the
+        serving hot path the dynamic batching exists for."""
         from .executor import _resolve_pendings, _run_batched_groups
 
         holder = ex.holder
@@ -201,7 +204,7 @@ class PreparedEntry:
             shards = sorted(idx.available_shards())
         results: list = [None] * self.n_calls
         _run_batched_groups(
-            ex.mesh_exec, holder, index, shards,
+            ex.batcher, holder, index, shards,
             ((g.kind, g.slotted, g.build_params(values), g.call_idxs,
               g.extra) for g in self.groups),
             results)

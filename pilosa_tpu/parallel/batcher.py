@@ -1,0 +1,558 @@
+"""Cross-query dynamic batching for device dispatch.
+
+Concurrent request threads used to launch one shard_map executable per
+query behind the process-wide collective-launch lock
+(mesh_exec._DISPATCH_LOCK): under load the device serialized one
+dispatch-floor launch per query, which is why the served HTTP path peaked
+~two orders of magnitude below the hand-batched engine path (BENCH_r05
+``2_http_path`` vs ``1_count_row_1shard``).  The reference amortizes
+per-query overhead by fanning shard jobs into a shared goroutine pool
+(executor.go:2455 mapReduce); the TPU-native analog is to coalesce
+compatible in-flight queries into ONE fused device launch — the
+continuous/dynamic-batching shape serving stacks use to amortize kernel
+dispatch.
+
+Mechanics: each per-shard reducer call (``count``, ``row_counts``,
+``bsi_sum``, ``segments``) is enqueued as a ticket keyed by its
+executable signature (reducer kind, slotted-plan repr, primary
+field/view, index, shard set, holder); a dispatcher thread drains
+compatible tickets — stacking their parametrized row/filter argument
+rows along a leading query axis, launching one jitted shard_map
+executable vmapped over that axis (mesh_exec's ``*_batch_async``
+executables), and scattering per-query result slices back to waiting
+futures.  Launch policy is adaptive: fire when the queue reaches
+``max_batch`` tickets or the oldest ticket has waited ``window_us``
+microseconds; fused query-axis sizes pad up to powers of two so
+compile-cache churn stays bounded.  A group that drains to a single
+singleton ticket falls through to the existing un-vmapped executables,
+so solo-query latency is unchanged (modulo the window wait).
+
+Deadlines (docs/robustness.md): time queued here counts against the
+query budget — tickets carry their QueryContext, and an expired or
+cancelled ticket is dropped from the batch BEFORE launch (its waiter
+gets DeadlineExceeded -> HTTP 504), never after.  Composition with the
+other serving layers (docs/batching.md): over-budget working sets (the
+PR1 shard-streaming path) bypass fusion and stream per ticket;
+result-cache lookups (PR3) happen before a ticket is ever created;
+admission control (PR2) gates the HTTP edge upstream of the queue.
+Multi-process meshes bypass the batcher entirely — independent
+per-process windows would fuse different batch shapes and wedge the
+collectives.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from contextlib import contextmanager
+
+import numpy as np
+
+from ..executor.plan import parametrize, plan_inputs
+from ..utils.deadline import DeadlineExceeded, activate, current
+from ..utils.faults import FAULTS
+from ..utils.stats import BucketHistogram, NopStatsClient, ReservoirTimer
+from .mesh_exec import _DISPATCH_LOCK
+
+_EMPTY_PARAMS = np.zeros(0, dtype=np.int32)
+
+# Total fused query-axis rows per launch: matrix tickets are pre-chunked
+# by executor._batch_chunks to keep per-device gather temps bounded, but
+# fusing k of them multiplies those temps by k — cap the fused row count
+# so a burst of large prepared batches cannot OOM the device.  A ticket
+# that alone exceeds the cap launches un-fused.
+FUSED_ROWS_MAX = 4096
+
+
+class _Ticket:
+    __slots__ = ("kind", "key", "params", "scalar", "payload", "ctx",
+                 "enq", "future", "background")
+
+    def __init__(self, kind, key, params, scalar, payload, background):
+        self.kind = kind
+        self.key = key
+        self.params = params          # [B_local, P] int32
+        self.scalar = scalar          # True: un-vmapped caller, scatter p[i]
+        self.payload = payload
+        self.ctx = current()          # the submitting query's deadline
+        self.enq = time.monotonic()
+        self.future = Future()
+        self.background = background
+
+
+class DispatchBatcher:
+    """Front door for every mesh reducer dispatch (docs/batching.md).
+
+    Request threads call the same-named wrappers below instead of the
+    MeshExecutor entry points; when batching is enabled the call becomes
+    a ticket and blocks until the dispatcher thread has LAUNCHED it
+    (results stay unfetched device arrays, preserving the executor's
+    dispatch-all-then-fetch-once pipeline).  Disabled (``dispatch-batch =
+    off``), every wrapper is a plain delegation — the explicit fallback
+    the check.sh dispatch lint allows."""
+
+    def __init__(self, mesh, enabled: bool = True, max_batch: int = 32,
+                 window_us: float = 200.0, stats=None):
+        self.mesh = mesh
+        self.enabled = enabled
+        self.max_batch = max(int(max_batch), 1)
+        self.window_s = max(float(window_us), 0.0) / 1e6
+        self.stats = stats if stats is not None else NopStatsClient()
+        self._cond = threading.Condition()
+        self._queue: list[_Ticket] = []
+        self._thread: threading.Thread | None = None
+        self._tid: int | None = None
+        self._closed = False
+        self._bg_local = threading.local()
+        # observability (surfaced at /debug/vars + /metrics)
+        self.fused_launches = 0
+        self.single_launches = 0
+        self.stream_fallbacks = 0
+        self.expired_drops = 0
+        self.batch_size_hist = BucketHistogram([1, 2, 4, 8, 16, 32, 64])
+        self.window_wait = ReservoirTimer(512)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _ensure_thread(self):
+        if self._thread is None:
+            t = threading.Thread(target=self._loop, daemon=True,
+                                 name="ptpu-dispatch")
+            self._thread = t
+            self._tid = None
+            t.start()
+
+    def close(self):
+        """Stop accepting tickets, drain the queue (remaining tickets
+        still launch — their waiters are blocked on the futures), and
+        join the dispatcher."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+
+    # -- routing -----------------------------------------------------------
+
+    def _use_ticket(self) -> bool:
+        # multiprocess: per-process windows would fuse DIFFERENT batch
+        # shapes across processes and wedge the collectives; dispatcher
+        # re-entrance would deadlock on its own queue
+        return (self.enabled and not self.mesh.multiprocess
+                and threading.get_ident() != self._tid)
+
+    def _submit(self, kind, key, params, scalar, payload):
+        bg = getattr(self._bg_local, "flag", False)
+        t = _Ticket(kind, key, np.ascontiguousarray(params, dtype=np.int32),
+                    scalar, payload, bg)
+        with self._cond:
+            if self._closed:
+                return None
+            self._ensure_thread()
+            self._queue.append(t)
+            self._cond.notify_all()
+        return t.future.result()
+
+    @contextmanager
+    def background(self):
+        """Mark this thread's submissions as background work (cache
+        rebuilds, maintenance): counted separately, and the thread is
+        expected to interleave ``yield_to_foreground()`` between units so
+        it never starves foreground queries of the dispatcher."""
+        self._bg_local.flag = True
+        try:
+            yield self
+        finally:
+            self._bg_local.flag = False
+
+    def yield_to_foreground(self, max_wait: float = 0.05):
+        """Bounded wait while foreground tickets are queued — background
+        loops (recalculate-caches rank rebuilds) call this between
+        fragments so a long rebuild can't monopolize the GIL/dispatcher
+        while queries wait."""
+        deadline = time.monotonic() + max_wait
+        while time.monotonic() < deadline:
+            with self._cond:
+                busy = any(not t.background for t in self._queue)
+            if not busy:
+                return
+            time.sleep(0.001)
+
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # -- public reducer surface (executor-facing) --------------------------
+
+    def count_async(self, plan, holder, index, shards) -> list:
+        if not self._use_ticket():
+            return self.mesh.count_async(plan, holder, index, shards)
+        slotted, params = parametrize(plan)
+        out = self._submit(
+            "count",
+            ("count", repr(slotted), index, tuple(shards), id(holder)),
+            np.asarray(params, dtype=np.int32).reshape(1, -1), True,
+            {"plan": plan, "slotted": slotted, "holder": holder,
+             "index": index, "shards": list(shards)})
+        if out is None:  # closed mid-flight: direct
+            return self.mesh.count_async(plan, holder, index, shards)
+        return out
+
+    def segments(self, plan, holder, index, shards) -> dict:
+        if not self._use_ticket():
+            return self.mesh.segments(plan, holder, index, shards)
+        slotted, params = parametrize(plan)
+        out = self._submit(
+            "segments",
+            ("segments", repr(slotted), index, tuple(shards), id(holder)),
+            np.asarray(params, dtype=np.int32).reshape(1, -1), True,
+            {"plan": plan, "slotted": slotted, "holder": holder,
+             "index": index, "shards": list(shards)})
+        if out is None:
+            return self.mesh.segments(plan, holder, index, shards)
+        return out
+
+    def _filter_slotted(self, filter_plan):
+        if filter_plan is None:
+            return None, _EMPTY_PARAMS
+        return parametrize(filter_plan)
+
+    def row_counts_async(self, field, view, filter_plan, holder, index,
+                         shards) -> list:
+        if not self._use_ticket():
+            return self.mesh.row_counts_async(field, view, filter_plan,
+                                              holder, index, shards)
+        slotted, params = self._filter_slotted(filter_plan)
+        out = self._submit(
+            "row_counts",
+            ("row_counts", field, view, repr(slotted), index,
+             tuple(shards), id(holder)),
+            np.asarray(params, dtype=np.int32).reshape(1, -1), True,
+            {"filter_plan": filter_plan, "slotted": slotted, "field": field,
+             "view": view, "holder": holder, "index": index,
+             "shards": list(shards)})
+        if out is None:
+            return self.mesh.row_counts_async(field, view, filter_plan,
+                                              holder, index, shards)
+        return out
+
+    def row_counts(self, field, view, filter_plan, holder, index,
+                   shards) -> np.ndarray:
+        return self.mesh.merge_counts(self.row_counts_async(
+            field, view, filter_plan, holder, index, shards))
+
+    def bsi_sum_async(self, field, view, filter_plan, holder, index,
+                      shards) -> list:
+        if not self._use_ticket():
+            return self.mesh.bsi_sum_async(field, view, filter_plan,
+                                           holder, index, shards)
+        slotted, params = self._filter_slotted(filter_plan)
+        out = self._submit(
+            "bsi_sum",
+            ("bsi_sum", field, view, repr(slotted), index, tuple(shards),
+             id(holder)),
+            np.asarray(params, dtype=np.int32).reshape(1, -1), True,
+            {"filter_plan": filter_plan, "slotted": slotted, "field": field,
+             "view": view, "holder": holder, "index": index,
+             "shards": list(shards)})
+        if out is None:
+            return self.mesh.bsi_sum_async(field, view, filter_plan,
+                                           holder, index, shards)
+        return out
+
+    # untouched-by-fusion reducers: explicit fallbacks so every dispatch
+    # still flows through one front door (check.sh lint)
+    def bsi_min_max(self, *args, **kwargs):
+        return self.mesh.bsi_min_max(*args, **kwargs)
+
+    def group_counts_batch_async(self, *args, **kwargs):
+        return self.mesh.group_counts_batch_async(*args, **kwargs)
+
+    # -- matrix surface (_run_batched_groups / prepared replay) ------------
+
+    def count_batch(self, slotted, params_mat, holder, index, shards,
+                    fuse: bool = True) -> list:
+        params_mat = np.asarray(params_mat, dtype=np.int32)
+        if fuse and self._use_ticket():
+            out = self._submit(
+                "count",
+                ("count", repr(slotted), index, tuple(shards), id(holder)),
+                params_mat, False,
+                {"slotted": slotted, "holder": holder, "index": index,
+                 "shards": list(shards)})
+            if out is not None:
+                return out
+        return self.mesh.count_batch_async(slotted, params_mat, holder,
+                                           index, shards)
+
+    def row_counts_batch(self, field, view, slotted, params_mat, holder,
+                         index, shards, fuse: bool = True) -> list:
+        params_mat = np.asarray(params_mat, dtype=np.int32)
+        if fuse and self._use_ticket():
+            out = self._submit(
+                "row_counts",
+                ("row_counts", field, view, repr(slotted), index,
+                 tuple(shards), id(holder)),
+                params_mat, False,
+                {"slotted": slotted, "field": field, "view": view,
+                 "holder": holder, "index": index, "shards": list(shards)})
+            if out is not None:
+                return out
+        return self.mesh.row_counts_batch_async(
+            field, view, slotted, params_mat, holder, index, shards)
+
+    def bsi_sum_batch(self, field, view, slotted, params_mat, holder,
+                      index, shards, fuse: bool = True) -> list:
+        params_mat = np.asarray(params_mat, dtype=np.int32)
+        if fuse and self._use_ticket():
+            out = self._submit(
+                "bsi_sum",
+                ("bsi_sum", field, view, repr(slotted), index,
+                 tuple(shards), id(holder)),
+                params_mat, False,
+                {"slotted": slotted, "field": field, "view": view,
+                 "holder": holder, "index": index, "shards": list(shards)})
+            if out is not None:
+                return out
+        return self.mesh.bsi_sum_batch_async(
+            field, view, slotted, params_mat, holder, index, shards)
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _loop(self):
+        self._tid = threading.get_ident()
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if not self._queue:
+                    return  # closed and drained
+                # adaptive window: launch when full OR the oldest ticket
+                # has waited its window (new arrivals re-check the gate)
+                limit = self._queue[0].enq + self.window_s
+                while not self._closed and \
+                        len(self._queue) < self.max_batch:
+                    now = time.monotonic()
+                    if now >= limit:
+                        break
+                    self._cond.wait(limit - now)
+                batch, self._queue = self._queue, []
+            try:
+                self._dispatch(batch)
+            except BaseException as e:  # the loop must survive anything
+                err = e if isinstance(e, Exception) else RuntimeError(
+                    f"dispatcher aborted: {e!r}")
+                for t in batch:
+                    if not t.future.done():
+                        t.future.set_exception(err)
+
+    def _dispatch(self, batch):
+        now = time.monotonic()
+        groups: dict[tuple, list[_Ticket]] = {}
+        for t in batch:
+            self.window_wait.observe(now - t.enq)
+            if t.background:
+                self.stats.count("dispatch.background")
+            ctx = t.ctx
+            if ctx is not None and ctx.expired():
+                # queued time counted against the budget: drop BEFORE the
+                # launch — the waiter maps this to 504 at the HTTP edge
+                try:
+                    ctx.check("dispatch batch window")
+                except DeadlineExceeded as e:
+                    t.future.set_exception(e)
+                else:  # pragma: no cover — expired() implies check raises
+                    t.future.set_exception(DeadlineExceeded(
+                        "query deadline exceeded in dispatch batch window"))
+                self.expired_drops += 1
+                self.stats.count("dispatch.expired_drop")
+                continue
+            groups.setdefault(t.key, []).append(t)
+        for key, tickets in groups.items():
+            # foreground first, then pack under the ticket and fused-row
+            # caps; an over-cap ticket launches alone (un-fused)
+            tickets.sort(key=lambda t: t.background)
+            pack: list[_Ticket] = []
+            rows = 0
+            for t in tickets:
+                n = t.params.shape[0]
+                if pack and (len(pack) >= self.max_batch
+                             or rows + n > FUSED_ROWS_MAX):
+                    self._launch(key[0], pack)
+                    pack, rows = [], 0
+                pack.append(t)
+                rows += n
+            if pack:
+                self._launch(key[0], pack)
+
+    def _fail_all(self, tickets, exc):
+        for t in tickets:
+            if not t.future.done():
+                t.future.set_exception(exc)
+
+    def _launch(self, kind, tickets):
+        self.batch_size_hist.observe(len(tickets))
+        if len(tickets) == 1:
+            t = tickets[0]
+            try:
+                # the ticket's QueryContext rides into the direct path so
+                # shard-slice deadline checks + failpoints behave exactly
+                # as an un-batched call would
+                with activate(t.ctx):
+                    result = self._direct(t)
+            except BaseException as e:
+                t.future.set_exception(
+                    e if isinstance(e, Exception)
+                    else RuntimeError(repr(e)))
+                return
+            self.single_launches += 1
+            self.stats.count("dispatch.launch.single")
+            t.future.set_result(result)
+            return
+        self._launch_fused(kind, tickets)
+
+    def _direct(self, t):
+        """Un-fused launch: scalar tickets take the existing un-vmapped
+        executables (solo-query latency unchanged); matrix tickets take
+        their batch executable directly."""
+        p = t.payload
+        mesh = self.mesh
+        if t.scalar:
+            if t.kind == "count":
+                return mesh.count_async(p["plan"], p["holder"], p["index"],
+                                        p["shards"])
+            if t.kind == "segments":
+                return mesh.segments(p["plan"], p["holder"], p["index"],
+                                     p["shards"])
+            if t.kind == "row_counts":
+                return mesh.row_counts_async(
+                    p["field"], p["view"], p["filter_plan"], p["holder"],
+                    p["index"], p["shards"])
+            return mesh.bsi_sum_async(
+                p["field"], p["view"], p["filter_plan"], p["holder"],
+                p["index"], p["shards"])
+        if t.kind == "count":
+            return mesh.count_batch_async(p["slotted"], t.params,
+                                          p["holder"], p["index"],
+                                          p["shards"])
+        if t.kind == "row_counts":
+            return mesh.row_counts_batch_async(
+                p["field"], p["view"], p["slotted"], t.params, p["holder"],
+                p["index"], p["shards"])
+        return mesh.bsi_sum_batch_async(
+            p["field"], p["view"], p["slotted"], t.params, p["holder"],
+            p["index"], p["shards"])
+
+    def _group_key_lists(self, kind, p):
+        if kind in ("count", "segments"):
+            return [plan_inputs(p["slotted"])]
+        return [self.mesh.batch_keys((p["field"], p["view"]),
+                                     p["slotted"])]
+
+    def _launch_fused(self, kind, tickets):
+        p0 = tickets[0].payload
+        mesh = self.mesh
+        try:
+            # PR1 composition: an over-budget working set streams in shard
+            # slices — the fused single-slice path would stage it whole,
+            # so stream each ticket through its direct path instead
+            sched = mesh.shard_schedule(
+                p0["holder"], p0["index"],
+                self._group_key_lists(kind, p0), p0["shards"])
+            if len(sched.slices) > 1:
+                self.stream_fallbacks += 1
+                self.stats.count("dispatch.launch.stream_fallback")
+                for t in tickets:
+                    self._launch(kind, [t])
+                return
+            mats = [t.params for t in tickets]
+            mat = np.concatenate(mats) if len(mats) > 1 else mats[0]
+            B = mat.shape[0]
+            pad = 1 << max(0, B - 1).bit_length()
+            if pad != B:  # pow-2 query axis bounds compile-cache churn
+                mat = np.concatenate(
+                    [mat, np.repeat(mat[-1:], pad - B, axis=0)])
+            # one failpoint/chaos gate per fused launch, matching the
+            # per-slice gate of the direct path
+            FAULTS.hit("mesh.slice", key=p0["index"])
+            if kind == "count":
+                parts = mesh.count_batch_async(
+                    p0["slotted"], mat, p0["holder"], p0["index"],
+                    p0["shards"])
+            elif kind == "row_counts":
+                parts = mesh.row_counts_batch_async(
+                    p0["field"], p0["view"], p0["slotted"], mat,
+                    p0["holder"], p0["index"], p0["shards"])
+            elif kind == "bsi_sum":
+                parts = mesh.bsi_sum_batch_async(
+                    p0["field"], p0["view"], p0["slotted"], mat,
+                    p0["holder"], p0["index"], p0["shards"])
+            else:  # segments
+                self._scatter_segments(tickets, mat, p0)
+                return
+            # scatter: per-ticket views into the fused device results.
+            # Outputs are replicated (psum, P() specs), so slicing is a
+            # local per-device gather — but hold the collective-launch
+            # lock anyway to keep one global program-enqueue order.
+            with _DISPATCH_LOCK:
+                lo = 0
+                for t in tickets:
+                    n = t.params.shape[0]
+                    if t.scalar:
+                        t.future.set_result([part[lo] for part in parts])
+                    else:
+                        t.future.set_result(
+                            [part[lo: lo + n] for part in parts])
+                    lo += n
+        except BaseException as e:
+            self._fail_all(tickets, e if isinstance(e, Exception)
+                           else RuntimeError(repr(e)))
+            return
+        self.fused_launches += 1
+        self.stats.count("dispatch.launch.fused")
+        self.stats.count("dispatch.fused_queries", len(tickets))
+
+    def _scatter_segments(self, tickets, mat, p0):
+        by_shard = self.mesh.segments_batch(
+            p0["slotted"], mat, p0["holder"], p0["index"], p0["shards"])
+        lo = 0
+        for t in tickets:  # segments tickets are always scalar (B=1)
+            t.future.set_result(
+                {shard: arr[lo] for shard, arr in by_shard.items()})
+            lo += t.params.shape[0]
+        self.fused_launches += 1
+        self.stats.count("dispatch.launch.fused")
+        self.stats.count("dispatch.fused_queries", len(tickets))
+
+    # -- observability ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "maxBatch": self.max_batch,
+            "windowUs": round(self.window_s * 1e6, 1),
+            "queued": self.pending(),
+            "fusedLaunches": self.fused_launches,
+            "singleLaunches": self.single_launches,
+            "streamFallbacks": self.stream_fallbacks,
+            "expiredDrops": self.expired_drops,
+            "batchSize": self.batch_size_hist.snapshot(),
+            "windowWaitS": self.window_wait.snapshot(),
+        }
+
+    def prometheus_text(self) -> str:
+        lines = self.batch_size_hist.prometheus_lines(
+            "pilosa_tpu_dispatch_batch_size")
+        ws = self.window_wait.snapshot()
+        lines.append("# TYPE pilosa_tpu_dispatch_window_wait_seconds "
+                     "summary")
+        for q, v in (("0.5", ws["p50"]), ("0.99", ws["p99"])):
+            if v is not None:
+                lines.append(
+                    f'pilosa_tpu_dispatch_window_wait_seconds'
+                    f'{{quantile="{q}"}} {v:.6g}')
+        lines.append("pilosa_tpu_dispatch_window_wait_seconds_count "
+                     f"{ws['count']}")
+        return "\n".join(lines) + "\n"

@@ -587,6 +587,67 @@ class MeshExecutor:
                 out[shard] = host[i]
         return out
 
+    def segments_batch(self, slotted, params_mat, holder, index,
+                       shards) -> dict[int, np.ndarray]:
+        """B same-shape bitmap plans in one executable invocation: the
+        query-axis variant of ``segments`` for the dispatch batcher
+        (parallel/batcher.py).  Returns {shard: [B, W] host array};
+        caller b's segment for a shard is ``out[shard][b]``.  Host
+        assembly mirrors ``segments`` (one device_get per shape group, no
+        per-row collectives)."""
+        keys = plan_inputs(slotted)
+        params = jnp.asarray(params_mat)
+        B = params.shape[0]
+        out: dict[int, np.ndarray] = {}
+        # pre-scheduled single-slice callers only (the batcher checks the
+        # shard schedule before fusing); multi-slice working sets stream
+        # through the un-fused ``segments`` path instead
+        for shard_list, placed, sig in self._placed_groups(
+                keys, holder, index, shards):
+            if all(s is None for s in sig):
+                zero = np.zeros((B, SHARD_WORDS), dtype=np.uint32)
+                for shard in shard_list:
+                    out[shard] = zero
+                continue
+            present = self._present(keys, placed, sig)
+            pkeys = tuple(k for k, _, _ in present)
+            pshapes = tuple(s for _, _, s in present)
+            key = self._plan_key("segmentsB", slotted, pkeys, pshapes)
+            fn = self._cache.get(key)
+            if fn is None:
+                def per_shard(params_, *arrays):
+                    frags = dict(zip(pkeys, arrays))
+                    return jax.vmap(
+                        lambda p: eval_plan(slotted, frags, p))(
+                            params_)                   # [B, W]
+
+                vmapped = jax.vmap(per_shard,
+                                   in_axes=(None,) + (0,) * len(pshapes))
+                if self.multiprocess:
+                    def block_fn(params_, *arrays):
+                        segs = vmapped(params_, *arrays)  # [S_local, B, W]
+                        return jax.lax.all_gather(segs, SHARD_AXIS,
+                                                  tiled=True)
+
+                    fn = self._jit_shard_map(
+                        key, block_fn,
+                        (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes),
+                        P(), check_vma=False)
+                else:
+                    def block_fn(params_, *arrays):
+                        return vmapped(params_, *arrays)  # [S_local, B, W]
+
+                    fn = self._jit_shard_map(
+                        key, block_fn,
+                        (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes),
+                        P(SHARD_AXIS))
+            with _DISPATCH_LOCK:
+                segs = fn(params, *[a for _, a, _ in present])
+            host = np.asarray(jax.device_get(segs))    # [S, B, W]
+            for i, shard in enumerate(shard_list):
+                out[shard] = host[i]
+        return out
+
     # -- row_counts: TopN/Rows/MinRow/MaxRow (fragment.go:1570 top) --------
 
     @staticmethod
@@ -789,9 +850,10 @@ class MeshExecutor:
         keys = plan_inputs(slotted)
         params = jnp.asarray(params_mat)               # [B, P]
         parts = []
-        # no _stream_groups here: the ONLY caller (_run_batched_groups)
-        # owns the slice schedule and passes pre-scheduled shard slices —
-        # re-scheduling would re-walk the holder per (group x chunk)
+        # no _stream_groups here: the callers (_run_batched_groups and
+        # the dispatch batcher) own the slice schedule and pass
+        # pre-scheduled shard slices — re-scheduling would re-walk the
+        # holder per (group x chunk)
         for shard_list, placed, sig in self._placed_groups(
                 keys, holder, index, shards):
             if all(s is None for s in sig):
@@ -830,9 +892,10 @@ class MeshExecutor:
         keys = self.batch_keys((field, view), slotted_filter)
         params = jnp.asarray(params_mat)
         parts = []
-        # no _stream_groups here: the ONLY caller (_run_batched_groups)
-        # owns the slice schedule and passes pre-scheduled shard slices —
-        # re-scheduling would re-walk the holder per (group x chunk)
+        # no _stream_groups here: the callers (_run_batched_groups and
+        # the dispatch batcher) own the slice schedule and pass
+        # pre-scheduled shard slices — re-scheduling would re-walk the
+        # holder per (group x chunk)
         for shard_list, placed, sig in self._placed_groups(
                 keys, holder, index, shards):
             if sig[0] is None:
@@ -881,9 +944,10 @@ class MeshExecutor:
         keys = self.batch_keys((field, view), slotted_filter)
         params = jnp.asarray(params_mat)
         parts = []
-        # no _stream_groups here: the ONLY caller (_run_batched_groups)
-        # owns the slice schedule and passes pre-scheduled shard slices —
-        # re-scheduling would re-walk the holder per (group x chunk)
+        # no _stream_groups here: the callers (_run_batched_groups and
+        # the dispatch batcher) own the slice schedule and pass
+        # pre-scheduled shard slices — re-scheduling would re-walk the
+        # holder per (group x chunk)
         for shard_list, placed, sig in self._placed_groups(
                 keys, holder, index, shards):
             if sig[0] is None or sig[0][0] < bsi.OFFSET_ROW + 1:

@@ -43,6 +43,14 @@ def serialize_result(r) -> object:
     return r
 
 
+class ClientAbort(Exception):
+    """The client went away mid-response (broken pipe / reset while
+    writing).  Expected serving noise, not a server error: counted as
+    ``http.client_abort`` and the connection is dropped quietly instead
+    of spewing a traceback per disconnect (the BENCH_r05 run log was full
+    of them from load-generator teardown)."""
+
+
 class Router:
     """Method+regex route table.
 
@@ -245,6 +253,11 @@ def build_router(api: API, server=None) -> Router:
                 "entries": len(ex.mesh_exec._stack_cache),
                 "executables": len(ex.mesh_exec._cache),
             }
+        # cross-query dynamic batching (docs/batching.md): fused/single
+        # launch counters, the batch-size histogram, and the queue-wait
+        # p50/p99 — the knobs' feedback loop for tuning window/max
+        if ex.batcher is not None:
+            out["dispatchBatcher"] = ex.batcher.snapshot()
         # overload armor: slot/queue state, per-peer breaker state, armed
         # failpoints (docs/robustness.md); deadline-abort and admission
         # rejection COUNTERS live in "counts" via the stats client
@@ -263,10 +276,16 @@ def build_router(api: API, server=None) -> Router:
             out["failpoints"] = armed
         return out
 
+    def metrics(req, args):
+        text = api.stats.prometheus_text()
+        # the batcher's histogram/summary series don't fit the stats
+        # client's counter/gauge model; it exports its own lines
+        if api.executor.batcher is not None:
+            text += api.executor.batcher.prometheus_text()
+        return ("text/plain; version=0.0.4", text)
+
     if api.stats is not None:
-        r.add("GET", "/metrics",
-              lambda req, a: ("text/plain; version=0.0.4",
-                              api.stats.prometheus_text()))
+        r.add("GET", "/metrics", metrics)
         r.add("GET", "/debug/vars", debug_vars)
 
     def debug_traces(req, args):
@@ -504,6 +523,10 @@ class _HandlerClass(BaseHTTPRequestHandler):
             self._send(409, {"error": str(e)})
         except DisallowedError as e:
             self._send(400, {"error": str(e)})
+        except ClientAbort:
+            # the client hung up mid-response: already counted, nothing
+            # left to send — just let the connection close
+            pass
         except (ApiError, ValueError) as e:
             self._send(400, {"error": str(e)})
         except Exception as e:  # panic guard (handler.go:325 recover)
@@ -516,14 +539,22 @@ class _HandlerClass(BaseHTTPRequestHandler):
 
     def _send_raw(self, code: int, ctype: str, payload: bytes,
                   headers: dict | None = None):
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(payload)))
-        if headers:
-            for k, v in headers.items():
-                self.send_header(k, v)
-        self.end_headers()
-        self.wfile.write(payload)
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            if headers:
+                for k, v in headers.items():
+                    self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(payload)
+        except (BrokenPipeError, ConnectionResetError,
+                TimeoutError) as e:
+            # client disconnected mid-write: a stat, not a stack trace
+            if self.stats is not None:
+                self.stats.count("http.client_abort")
+            self.close_connection = True
+            raise ClientAbort(str(e)) from e
 
     def do_GET(self):
         self._handle("GET")
@@ -565,6 +596,17 @@ class TrackingHTTPServer(ThreadingHTTPServer):
         with self._conns_lock:
             self._conns.discard(request)
         super().shutdown_request(request)
+
+    def handle_error(self, request, client_address):
+        # disconnect-while-reading surfaces here (the write path maps to
+        # ClientAbort inside the handler): expected client churn, not a
+        # traceback per dropped connection
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError,
+                            TimeoutError, ClientAbort)):
+            return
+        super().handle_error(request, client_address)
 
     def close_connections(self):
         import socket as _socket

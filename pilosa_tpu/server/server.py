@@ -33,6 +33,17 @@ class Config:
     # execution: serve queries through the device-mesh executor (stacked
     # shard batches + ICI reductions); off = per-shard host dispatch
     use_mesh: bool = True
+    # -- cross-query dynamic batching (docs/batching.md) -------------------
+    # Coalesce compatible concurrent queries into one fused device launch
+    # (vmapped over a query axis) instead of one shard_map launch each
+    # behind the collective-launch lock.  Off = every dispatch goes
+    # straight to its own executable (the pre-batching behavior).
+    dispatch_batch: bool = True
+    # Queries per fused launch before the dispatcher fires early.
+    dispatch_batch_max: int = 32
+    # Microseconds the oldest queued ticket may wait for company before
+    # the batch launches anyway (the solo-query latency tax ceiling).
+    dispatch_batch_window_us: float = 200.0
     # HBM budget for device-resident fragment mirrors + stacked shard
     # blocks (storage/membudget.py DeviceBudget — the syswrap map-cap
     # analog, syswrap/mmap.go:46).  0 = unlimited (accounting only).
@@ -124,6 +135,11 @@ class Config:
             "PILOSA_TPU_VERBOSE": ("verbose", lambda s: s == "true"),
             "PILOSA_TPU_MAX_ROW_ID": ("max_row_id", int),
             "PILOSA_TPU_USE_MESH": ("use_mesh", lambda s: s != "false"),
+            "PILOSA_TPU_DISPATCH_BATCH": (
+                "dispatch_batch", lambda s: s != "false"),
+            "PILOSA_TPU_DISPATCH_BATCH_MAX": ("dispatch_batch_max", int),
+            "PILOSA_TPU_DISPATCH_BATCH_WINDOW_US": (
+                "dispatch_batch_window_us", float),
             "PILOSA_TPU_DEVICE_BUDGET_MB": ("device_budget_mb", int),
             "PILOSA_TPU_HOST_STAGE_MB": ("host_stage_mb", int),
             "PILOSA_TPU_METRIC_SERVICE": ("metric_service", str),
@@ -172,6 +188,9 @@ class Config:
         mapping = {
             "data-dir": "data_dir", "bind": "bind", "max-op-n": "max_op_n",
             "max-row-id": "max_row_id", "use-mesh": "use_mesh",
+            "dispatch-batch": "dispatch_batch",
+            "dispatch-batch-max": "dispatch_batch_max",
+            "dispatch-batch-window-us": "dispatch_batch_window_us",
             "device-budget-mb": "device_budget_mb",
             "host-stage-mb": "host_stage_mb",
             "max-body-mb": "max_body_mb",
@@ -255,8 +274,12 @@ class Server:
                 # to it with a read-through cache
                 self.holder.translate_factory = \
                     self.cluster.remote_translate_factory
-        self.api = API(self.holder, cluster=self.cluster, stats=self.stats,
-                       use_mesh=self.config.use_mesh)
+        self.api = API(
+            self.holder, cluster=self.cluster, stats=self.stats,
+            use_mesh=self.config.use_mesh,
+            dispatch_batch=self.config.dispatch_batch,
+            dispatch_batch_max=self.config.dispatch_batch_max,
+            dispatch_batch_window_us=self.config.dispatch_batch_window_us)
         # query cache subsystem (docs/caching.md): byte budget for the
         # result cache; the rank-rebuild threshold is process-wide like
         # the memory budgets (most recent Server's config wins)

@@ -106,6 +106,89 @@ class StatsClient:
         return "\n".join(lines) + "\n"
 
 
+class BucketHistogram:
+    """Fixed-bucket counting histogram — bounded memory for always-on
+    hot-path recording (the dispatch batcher's batch-size distribution).
+    ``bounds`` are inclusive upper edges; values above the last bound land
+    in the +Inf bucket."""
+
+    def __init__(self, bounds):
+        self.bounds = list(bounds)
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, v: float):
+        with self._lock:
+            self.count += 1
+            self.total += v
+            for i, b in enumerate(self.bounds):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {f"le_{b}": c for b, c in zip(self.bounds, self._counts)}
+            out["le_inf"] = self._counts[-1]
+            out["count"] = self.count
+            out["sum"] = self.total
+            return out
+
+    def prometheus_lines(self, name: str) -> list[str]:
+        """Cumulative-bucket exposition (Prometheus histogram type)."""
+        with self._lock:
+            lines = [f"# TYPE {name} histogram"]
+            cum = 0
+            for b, c in zip(self.bounds, self._counts):
+                cum += c
+                lines.append(f'{name}_bucket{{le="{b}"}} {cum}')
+            cum += self._counts[-1]
+            lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{name}_sum {self.total}")
+            lines.append(f"{name}_count {self.count}")
+            return lines
+
+
+class ReservoirTimer:
+    """Ring buffer of the last ``size`` duration samples; percentile()
+    computes order statistics over a snapshot copy.  O(size) memory over
+    a server's lifetime, like the aggregated timings above — but able to
+    answer p50/p99 (the window-wait distribution the batch dispatcher
+    publishes)."""
+
+    def __init__(self, size: int = 512):
+        self.size = size
+        self._buf: list[float] = []
+        self._pos = 0
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def observe(self, v: float):
+        with self._lock:
+            self.count += 1
+            if len(self._buf) < self.size:
+                self._buf.append(v)
+            else:
+                self._buf[self._pos] = v
+                self._pos = (self._pos + 1) % self.size
+
+    def percentile(self, q: float) -> float | None:
+        with self._lock:
+            buf = sorted(self._buf)
+        if not buf:
+            return None
+        i = min(len(buf) - 1, int(q * (len(buf) - 1) + 0.5))
+        return buf[i]
+
+    def snapshot(self) -> dict:
+        return {"count": self.count,
+                "p50": self.percentile(0.5),
+                "p99": self.percentile(0.99)}
+
+
 class StatsdClient(StatsClient):
     """StatsClient that ALSO emits DataDog-flavored statsd UDP datagrams
     (reference statsd/statsd.go) while keeping the in-process snapshot so

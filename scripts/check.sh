@@ -31,6 +31,21 @@ if [ -n "$bad" ]; then
     exit 1
 fi
 
+# Device dispatch must flow through the dispatch batcher (docs/batching.md):
+# a direct shard_map-reducer call outside parallel/ bypasses cross-query
+# fusion, the queued-deadline drop-out, and the dispatch stats.  Everything
+# goes through DispatchBatcher's same-named wrappers (or its explicit
+# disabled-mode fallback); only parallel/ touches the executables.
+bad=$(grep -rnE --include="*.py" \
+    "(mesh|mesh_exec)\.(count_async|count_batch_async|segments|segments_batch|row_counts|bsi_sum|bsi_min_max|group_counts)" \
+    pilosa_tpu --exclude-dir=parallel || true)
+if [ -n "$bad" ]; then
+    echo "FAIL: direct mesh shard_map dispatch outside parallel/ (route" \
+         "through executor.batcher — parallel/batcher.py):"
+    echo "$bad"
+    exit 1
+fi
+
 # committed bytecode/cache artifacts must never land in the tree
 bad=$(git ls-files | grep -E "__pycache__|\.pyc$" || true)
 if [ -n "$bad" ]; then
