@@ -876,6 +876,84 @@ def run_http_batch_smoke(rng) -> dict:
     return out
 
 
+def run_observability_smoke(rng, baseline_qps=None) -> dict:
+    """Observability leg of --smoke (docs/observability.md): with
+    tracing, latency histograms, and the slow-query log all armed, the
+    profile-OFF serving path must stay within noise of the PR 4 batching
+    leg (< 5%: collection is a contextvar read and a histogram bucket
+    increment per stage), and ``?profile=true`` must return a populated
+    stage tree whose trace id resolves at /debug/traces."""
+    import tempfile
+    import urllib.request
+
+    from pilosa_tpu.core import SHARD_WIDTH
+    from pilosa_tpu.server.server import Config, Server
+
+    out = {}
+    srv = Server(Config(
+        data_dir=tempfile.mkdtemp(prefix="ptpu_smko_"),
+        bind="localhost:0", anti_entropy_interval=0,
+        dispatch_batch_window_us=1000,
+        slow_query_threshold=0.5, trace_sample_rate=1.0))
+    try:
+        srv.open()
+
+        def post(path, body):
+            req = urllib.request.Request(
+                f"http://localhost:{srv.port}{path}", method="POST",
+                data=body.encode())
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.read()
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://localhost:{srv.port}{path}",
+                    timeout=30) as resp:
+                return resp.read()
+
+        cols = rng.integers(0, SHARD_WIDTH, size=20_000)
+        rws = rng.integers(0, 64, size=20_000)
+        post("/index/obs", "{}")
+        post("/index/obs/field/f", "{}")
+        post("/index/obs/field/f/import", json.dumps(
+            {"rowIDs": rws.tolist(), "columnIDs": cols.tolist()}))
+        # same load shape as the batching leg; best-of-2 after a warm
+        # pass so a stray scheduler hiccup can't fail the 5% bound
+        _http_count_load(srv.port, "obs", "f", 64, rng, 16, per_thread=8)
+        qps = max(_http_count_load(srv.port, "obs", "f", 64, rng, 16,
+                                   per_thread=32)[0]
+                  for _ in range(2))
+        out["qps"] = round(qps, 1)
+        if baseline_qps:
+            out["overhead_pct"] = round(
+                100.0 * (1.0 - qps / baseline_qps), 1)
+            assert qps >= 0.95 * baseline_qps, \
+                (f"profile-off observability overhead over 5%: "
+                 f"{qps:.0f} qps vs batching leg {baseline_qps:.0f}")
+        # profile-on: a populated stage tree, inline with the response
+        prof = json.loads(post("/index/obs/query?profile=true",
+                               "Count(Row(f=7))"))
+        assert prof.get("profile", {}).get("children"), \
+            "?profile=true returned an empty stage tree"
+        out["profile_stages"] = len(prof["profile"]["children"])
+        tid = prof["traceID"]
+        spans = json.loads(get(f"/debug/traces?trace={tid}"))["spans"]
+        assert spans, "profile trace id unknown to /debug/traces"
+        # slow-query log: drop the threshold and capture one
+        srv.slowlog.threshold_s = 1e-9
+        post("/index/obs/query", "Count(Row(f=9))")
+        slow = json.loads(get("/debug/slow"))
+        assert slow["entries"], "slow-query log captured nothing"
+        out["slow_recorded"] = slow["recorded"]
+        # histograms: p99 derivable from the exposition
+        text = get("/metrics").decode()
+        assert "pilosa_tpu_http_query_seconds_bucket" in text, \
+            "/metrics lacks the http.query latency histogram"
+    finally:
+        srv.close()
+    return out
+
+
 def _smoke_norm(results):
     """TopN results -> comparable (id, count) lists."""
     return [[(p.id, p.count) for p in r] for r in results]
@@ -1087,6 +1165,9 @@ def run_smoke():
     out["cache"] = run_cache_smoke(np.random.default_rng(SEED + 3))
     out["overload"] = run_overload_smoke()
     out["http_batch"] = run_http_batch_smoke(np.random.default_rng(SEED + 4))
+    out["observability"] = run_observability_smoke(
+        np.random.default_rng(SEED + 5),
+        baseline_qps=out["http_batch"]["qps_on"])
     out["total_s"] = round(time.perf_counter() - t_start, 2)
     print(json.dumps(out))
 

@@ -107,9 +107,16 @@ class API:
         from .utils.deadline import current
         if ctx is None:
             ctx = current()
+        from .utils import profile as qprof
         from .utils.tracing import GLOBAL_TRACER
         with GLOBAL_TRACER.span("api.Query") as span:
             span.set_tag("index", index)
+            prof = qprof.current()
+            if prof is not None:
+                # root tags of the EXPLAIN ANALYZE tree: the index and
+                # the trace id the stages correlate to
+                prof.tag("index", index)
+                prof.tag("traceID", span.trace_id)
             if self.cluster is not None:
                 return self.cluster.execute(index, query, shards, ctx=ctx)
             return self.executor.execute(index, query, shards, ctx=ctx)

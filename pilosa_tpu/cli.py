@@ -230,6 +230,12 @@ max-op-n = 10000
 # queue-timeout = 0.5      # seconds to wait for a slot before 503
 # breaker-threshold = 5    # consecutive peer failures -> circuit open
 # drain-seconds = 5        # graceful-drain budget on shutdown
+# observability (docs/observability.md)
+# slow-query-threshold = 1 # seconds before a query lands in /debug/slow
+# slow-log-size = 128      # slow-query ring-buffer entries
+# profile-default = false  # profile tree on every response, not just
+#                          # ?profile=true
+# trace-sample-rate = 1.0  # fraction of traces recorded (cluster-wide)
 
 [cluster]
 # hosts = ["localhost:10101", "localhost:10102"]
@@ -271,6 +277,10 @@ def cmd_config(args) -> int:
     print(f"breaker-threshold = {cfg.breaker_threshold}")
     print(f"drain-seconds = {cfg.drain_seconds}")
     print(f"health-down-threshold = {cfg.health_down_threshold}")
+    print(f"slow-query-threshold = {cfg.slow_query_threshold}")
+    print(f"slow-log-size = {cfg.slow_log_size}")
+    print(f"profile-default = {str(cfg.profile_default).lower()}")
+    print(f"trace-sample-rate = {cfg.trace_sample_rate}")
     print()
     print("[cluster]")
     print(f"hosts = [{', '.join(q(h) for h in cfg.cluster_hosts)}]")

@@ -374,7 +374,18 @@ class Executor:
 
     def _execute_ctx(self, index_name: str, query, shards, translate,
                      check_current) -> list[Any]:
+        from ..utils import profile as qprof
+        from ..utils.tracing import GLOBAL_TRACER
         check_current("execute")
+        # one span per execution so a remote node's piggybacked trace
+        # carries its execution stage (docs/observability.md)
+        with GLOBAL_TRACER.span("executor.execute") as espan:
+            espan.set_tag("index", index_name)
+            return self._execute_stages(index_name, query, shards,
+                                        translate, check_current, qprof)
+
+    def _execute_stages(self, index_name: str, query, shards, translate,
+                        check_current, qprof) -> list[Any]:
         stats = self.stats
         # Result-cache lookup FIRST (before even the parse): node-local
         # entries key on the query text (an AST keys on its normalized
@@ -390,24 +401,30 @@ class Executor:
                     shards = sorted(idx0.available_shards())
                 from ..core import attr_epoch, schema_epoch
                 from ..cache.results import gen_vector
+                from ..utils.tracing import GLOBAL_TRACER
                 qrepr = query if isinstance(query, str) else repr(query)
                 qkey = ("local", index_name, qrepr, tuple(shards),
                         bool(translate))
                 ckey = qkey + (gen_vector(self.holder, index_name,
                                           set(shards)),
                                schema_epoch(), attr_epoch())
-                from ..utils.tracing import GLOBAL_TRACER
-                with GLOBAL_TRACER.span("resultcache.lookup") as span:
+                with GLOBAL_TRACER.span("resultcache.lookup") as span, \
+                        qprof.stage("resultcache.lookup") as pnode:
                     out = cache.lookup(ckey)
-                    span.set_tag("outcome",
-                                 "hit" if out is not None else "miss")
+                    outcome = "hit" if out is not None else "miss"
+                    span.set_tag("outcome", outcome)
+                    if pnode is not None:
+                        pnode.tags["outcome"] = outcome
                 if out is not None:
                     return out
         if isinstance(query, str):
             if translate and self.prepared is not None:
-                with stats.timer("query.prepared"):
+                with stats.timer("query.prepared"), \
+                        qprof.stage("prepared") as pnode:
                     hit, out = self.prepared.attempt(index_name, query,
                                                      shards)
+                    if pnode is not None:
+                        pnode.tags["outcome"] = "hit" if hit else "miss"
                 if hit:
                     stats.count("query.prepared.hit")
                     if ckey is not None:
@@ -419,7 +436,7 @@ class Executor:
                 if out is not None:
                     query = out  # parsed (tagged) AST — don't parse twice
             if isinstance(query, str):
-                with stats.timer("query.parse"):
+                with stats.timer("query.parse"), qprof.stage("parse"):
                     query = parse(query)
         idx = self.holder.index(index_name)
         if idx is None:
@@ -428,14 +445,24 @@ class Executor:
             # always runs: validates stray string keys even when no store
             # is enabled (executor.go:2658 "string 'col' value not
             # allowed...")
-            with stats.timer("query.translate"):
+            with stats.timer("query.translate"), qprof.stage("translate"):
                 query = self.translator.translate_query(index_name, query)
         if shards is None:
             shards = sorted(idx.available_shards())
         # Batched grouping reorders dispatch, which is only sound when no
         # call mutates state a later call could read — mixed write/read
         # queries run strictly sequentially like the reference.
-        with stats.timer("query.dispatch"):
+        with stats.timer("query.dispatch"), \
+                qprof.stage("dispatch") as dnode:
+            if dnode is not None:
+                # device-budget counters bracketing the dispatch: the
+                # deltas attribute upload/eviction traffic to THIS query
+                # (approximate under concurrency — they are process-wide)
+                from ..storage.membudget import DEFAULT_BUDGET
+                up0, ev0 = (DEFAULT_BUDGET.upload_bytes,
+                            DEFAULT_BUDGET.evictions)
+                dnode.tags["calls"] = len(query.calls)
+                dnode.tags["shards"] = len(shards)
             if self.mesh_exec is not None and len(query.calls) > 1 and \
                     not any(c.name in WRITE_CALLS for c in query.calls):
                 results = self._execute_calls_grouped(index_name,
@@ -446,8 +473,12 @@ class Executor:
                     check_current("call dispatch")
                     results.append(self._execute_call(index_name, c,
                                                       shards))
+            if dnode is not None:
+                dnode.tags["uploadBytes"] = \
+                    DEFAULT_BUDGET.upload_bytes - up0
+                dnode.tags["evictions"] = DEFAULT_BUDGET.evictions - ev0
         check_current("result fetch")
-        with stats.timer("query.fetch"):
+        with stats.timer("query.fetch"), qprof.stage("fetch"):
             results = _resolve_pendings(results)
         if translate and self.translator.needs_translation(index_name):
             results = self.translator.translate_results(

@@ -50,9 +50,11 @@ from contextlib import contextmanager
 import numpy as np
 
 from ..executor.plan import parametrize, plan_inputs
+from ..utils import profile as qprof
 from ..utils.deadline import DeadlineExceeded, activate, current
 from ..utils.faults import FAULTS
 from ..utils.stats import BucketHistogram, NopStatsClient, ReservoirTimer
+from ..utils.tracing import GLOBAL_TRACER
 from .mesh_exec import _DISPATCH_LOCK
 
 _EMPTY_PARAMS = np.zeros(0, dtype=np.int32)
@@ -67,7 +69,8 @@ FUSED_ROWS_MAX = 4096
 
 class _Ticket:
     __slots__ = ("kind", "key", "params", "scalar", "payload", "ctx",
-                 "enq", "future", "background")
+                 "enq", "future", "background", "trace", "prof",
+                 "prof_node")
 
     def __init__(self, kind, key, params, scalar, payload, background):
         self.kind = kind
@@ -76,6 +79,12 @@ class _Ticket:
         self.scalar = scalar          # True: un-vmapped caller, scatter p[i]
         self.payload = payload
         self.ctx = current()          # the submitting query's deadline
+        # trace + profile context cross the dispatcher-thread boundary
+        # with the ticket (a thread-local would silently drop them):
+        # spans/stage events recorded at launch parent under the
+        # submitting query (docs/observability.md)
+        self.trace = GLOBAL_TRACER.capture()
+        self.prof, self.prof_node = qprof.capture()
         self.enq = time.monotonic()
         self.future = Future()
         self.background = background
@@ -353,6 +362,11 @@ class DispatchBatcher:
         groups: dict[tuple, list[_Ticket]] = {}
         for t in batch:
             self.window_wait.observe(now - t.enq)
+            if t.prof is not None:
+                # queue + coalesce wait, attributed under the stage the
+                # query was in when it submitted (its dispatch node)
+                t.prof.event("batcher.queue", now - t.enq,
+                             node=t.prof_node, kind=t.kind)
             if t.background:
                 self.stats.count("dispatch.background")
             ctx = t.ctx
@@ -399,9 +413,17 @@ class DispatchBatcher:
             try:
                 # the ticket's QueryContext rides into the direct path so
                 # shard-slice deadline checks + failpoints behave exactly
-                # as an un-batched call would
-                with activate(t.ctx):
+                # as an un-batched call would; trace + profile context
+                # re-attach so slice events/spans parent under the query
+                with activate(t.ctx), GLOBAL_TRACER.attach(t.trace), \
+                        qprof.activate(t.prof):
+                    t0 = time.perf_counter()
                     result = self._direct(t)
+                    if t.prof is not None:
+                        t.prof.event("batcher.launch",
+                                     time.perf_counter() - t0,
+                                     node=t.prof_node, kind=t.kind,
+                                     fused=False)
             except BaseException as e:
                 t.future.set_exception(
                     e if isinstance(e, Exception)
@@ -451,9 +473,27 @@ class DispatchBatcher:
         return [self.mesh.batch_keys((p["field"], p["view"]),
                                      p["slotted"])]
 
+    def _note_fused(self, tickets, dur_s):
+        """Attribute one fused launch back to every participating query:
+        a profile event under each ticket's captured node and a
+        synthesized span under each sampled trace (there is no single
+        owner to nest a live span under)."""
+        for t in tickets:
+            if t.prof is not None:
+                t.prof.event("batcher.launch", dur_s, node=t.prof_node,
+                             kind=t.kind, fused=True,
+                             batchTickets=len(tickets))
+            if t.trace is not None and t.trace.sampled:
+                GLOBAL_TRACER.record_span(
+                    "dispatch.fused_launch", t.trace.trace_id,
+                    t.trace.span_id, dur_s,
+                    {"kind": t.kind, "tickets": len(tickets)},
+                    collect=t.trace.collect)
+
     def _launch_fused(self, kind, tickets):
         p0 = tickets[0].payload
         mesh = self.mesh
+        t_launch0 = time.perf_counter()
         try:
             # PR1 composition: an over-budget working set streams in shard
             # slices — the fused single-slice path would stage it whole,
@@ -492,6 +532,11 @@ class DispatchBatcher:
             else:  # segments
                 self._scatter_segments(tickets, mat, p0)
                 return
+            # attribute the launch BEFORE resolving any future: once a
+            # future resolves, its owner thread may serialize the profile
+            # tree, and late appends would race that (profile.py's
+            # owner-blocked invariant)
+            self._note_fused(tickets, time.perf_counter() - t_launch0)
             # scatter: per-ticket views into the fused device results.
             # Outputs are replicated (psum, P() specs), so slicing is a
             # local per-device gather — but hold the collective-launch
@@ -515,8 +560,11 @@ class DispatchBatcher:
         self.stats.count("dispatch.fused_queries", len(tickets))
 
     def _scatter_segments(self, tickets, mat, p0):
+        t_launch0 = time.perf_counter()
         by_shard = self.mesh.segments_batch(
             p0["slotted"], mat, p0["holder"], p0["index"], p0["shards"])
+        # as in _launch_fused: attribute before any future resolves
+        self._note_fused(tickets, time.perf_counter() - t_launch0)
         lo = 0
         for t in tickets:  # segments tickets are always scalar (B=1)
             t.future.set_result(

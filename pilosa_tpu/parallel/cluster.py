@@ -50,8 +50,10 @@ from ..executor.results import (
 )
 from ..pql import Call, Query, parse
 from ..pql.wire import call_from_wire, call_to_wire
+from ..utils import profile as qprof
 from ..utils.deadline import DEADLINE_HEADER, current as current_ctx
 from ..utils.faults import FAULTS
+from ..utils.tracing import GLOBAL_TRACER, PROBE_HEADER, TRACE_HEADER
 from .placement import Placement
 
 NODE_READY = "READY"
@@ -359,6 +361,13 @@ class InternalClient:
             conns = self._local.conns = {}
         headers = {"Content-Type": ctype,
                    "Content-Length": str(len(body or b""))}
+        # Trace propagation (http/client.go:1043 inject): every outbound
+        # hop carries trace_id:parent_span_id when a trace is active, so
+        # remote spans parent correctly under the calling span.  Probes
+        # run on the probe pool with no active trace — no header.
+        trace_hdr = GLOBAL_TRACER.inject()
+        if trace_hdr is not None:
+            headers[TRACE_HEADER] = trace_hdr
         if headers_extra:
             headers.update(headers_extra)
 
@@ -448,9 +457,12 @@ class InternalClient:
                probe: bool = False) -> dict:
         """``probe=True``: this is a health probe — it rides through an
         open breaker as the half-open trial (the designated recovery
-        path; see _breaker_allow)."""
+        path; see _breaker_allow), and is TAGGED on the wire so the
+        peer excludes it from latency histograms and the slow-query log
+        (background traffic must not pollute p99)."""
+        headers = {PROBE_HEADER: "1"} if probe else None
         return self._json(host, "GET", "/status", timeout=timeout,
-                          breaker_trial=probe)
+                          headers=headers, breaker_trial=probe)
 
     @staticmethod
     def _deadline_extras(deadline_s, base_timeout):
@@ -498,6 +510,10 @@ class InternalClient:
             "calls": [call_to_wire(c) for c in calls],
             "shards": shards,
         }, timeout=timeout, headers=headers)
+        # remote span summaries piggyback on the response (like the gen
+        # summaries below): fold them into the local ring so
+        # /debug/traces on the coordinator renders the whole cluster tree
+        GLOBAL_TRACER.adopt(out.get("spans"))
         return ([result_from_wire(r) for r in out["results"]],
                 float(out.get("execS", 0.0)), out.get("gens"))
 
@@ -1077,7 +1093,8 @@ class Cluster:
 
     def _execute_ctx(self, index: str, query, shards) -> list[Any]:
         if isinstance(query, str):
-            query = parse(query)
+            with qprof.stage("parse"):
+                query = parse(query)
         if self.holder.index(index) is None:
             from ..api import NotFoundError
             raise NotFoundError(f"index not found: {index}")
@@ -1096,7 +1113,8 @@ class Cluster:
         # internal calls carry ids only (executor.go:147 skips
         # translateCalls when opt.Remote)
         translator = self.api.executor.translator
-        query = translator.translate_query(index, query)
+        with qprof.stage("translate"):
+            query = translator.translate_query(index, query)
         if shards is None:
             shards = self._available_shards(index)
         # Coordinator-scope result cache: keyed on the NORMALIZED plan
@@ -1120,8 +1138,14 @@ class Cluster:
                 local_part = (gen_vector(self.holder, index),
                               schema_epoch(), attr_epoch(),
                               self._peer_write_vector(index))
-                out = cache.lookup(
-                    qkey + local_part + (self._peer_seen_vector(index),))
+                with qprof.stage("resultcache.lookup") as pnode:
+                    out = cache.lookup(
+                        qkey + local_part
+                        + (self._peer_seen_vector(index),))
+                    if pnode is not None:
+                        pnode.tags["outcome"] = \
+                            "hit" if out is not None else "miss"
+                        pnode.tags["scope"] = "cluster"
                 if out is not None:
                     return out
         if len(query.calls) > 1 and \
@@ -1202,7 +1226,7 @@ class Cluster:
         grouped = self._fan_out_multi(index, phase1, shards)
         results: list[Any] = [None] * len(calls)
         phase2: list[tuple[int, Call]] = []
-        with stats.timer("cluster.multi.reduce"):
+        with stats.timer("cluster.multi.reduce"), qprof.stage("reduce"):
             for i, c in enumerate(calls):
                 if i in two_phase:
                     cands = sorted({p.id for r in grouped[i] for p in r})
@@ -1215,7 +1239,8 @@ class Cluster:
         if phase2:
             r2 = self._fan_out_multi(index, [p for _, p in phase2],
                                      shards)
-            with stats.timer("cluster.multi.reduce"):
+            with stats.timer("cluster.multi.reduce"), \
+                    qprof.stage("reduce"):
                 for (i, _p2), rr in zip(phase2, r2):
                     results[i] = self._topn_finalize(calls[i], rr)
         return results
@@ -1271,11 +1296,20 @@ class Cluster:
                 args = (self.by_id[nid].host, index, calls, nshards)
                 if deadline_s is not None:
                     args += (deadline_s,)
+                # task(): the pool worker re-installs this thread's trace
+                # context and runs the RPC under a per-peer client span —
+                # the injected header then carries that span's id, so the
+                # remote's spans parent under it (docs/observability.md)
                 futures[nid] = (nshards, time.perf_counter(),
                                 self._pool.submit(
-                                    self.client.query_calls, *args))
+                                    GLOBAL_TRACER.task(
+                                        self.client.query_calls,
+                                        name=f"cluster.rpc {nid}",
+                                        host=self.by_id[nid].host),
+                                    *args))
             if local_shards is not None:
-                with stats.timer("cluster.multi.local_exec"):
+                with stats.timer("cluster.multi.local_exec"), \
+                        qprof.stage("local_exec"):
                     for i, r in enumerate(self.api.executor.execute(
                             index, q, local_shards, translate=False)):
                         out[i].append(r)
@@ -1287,6 +1321,14 @@ class Cluster:
                     stats.timing("cluster.multi.peer_exec", exec_s)
                     stats.timing("cluster.multi.wire_overhead",
                                  max(elapsed - exec_s, 0.0))
+                    # per-peer fan-out RTT in the profile tree: total
+                    # round trip, the peer's own execution time, and the
+                    # wire/serialization overhead between them
+                    qprof.event(f"peer.{nid}", elapsed,
+                                shards=len(nshards),
+                                peerExecS=round(exec_s, 6),
+                                wireS=round(max(elapsed - exec_s, 0.0),
+                                            6))
                     self.note_peer_gens(index, nid, peer_gens)
                     for i, r in enumerate(res):
                         out[i].append(r)
@@ -1526,8 +1568,8 @@ class Cluster:
         for nid in owners:
             if nid != self.node_id:
                 futures.append(self._pool.submit(
-                    self.client.query_call, self.by_id[nid].host, index, c,
-                    [shard]))
+                    GLOBAL_TRACER.task(self.client.query_call),
+                    self.by_id[nid].host, index, c, [shard]))
         result = self._local_exec(index, c, [shard]) \
             if self.node_id in owners else None
         remote = None
@@ -1549,7 +1591,8 @@ class Cluster:
             if not owned or n.id == self.node_id:
                 continue
             futures.append(self._pool.submit(
-                self.client.query_call, n.host, index, c, owned))
+                GLOBAL_TRACER.task(self.client.query_call),
+                n.host, index, c, owned))
         local_owned = self.placement.owned_shards(self.node_id, index,
                                                   shards)
         if local_owned:
@@ -1569,9 +1612,10 @@ class Cluster:
         self.note_peer_write(index, [n.id for n in self.peers()])
         # local write FIRST: if it fails, no peer has diverged yet
         out = self._local_exec(index, c, [])
-        futures = [self._pool.submit(self.client.query_call, n.host, index,
-                                     c, [])
-                   for n in self.peers()]
+        futures = [self._pool.submit(
+            GLOBAL_TRACER.task(self.client.query_call), n.host, index,
+            c, [])
+            for n in self.peers()]
         errors = []
         for f in futures:
             try:
@@ -1743,8 +1787,8 @@ class Cluster:
                 local_payload = payload
                 continue
             futures.append(self._pool.submit(
-                self.client.import_local, self.by_id[nid].host, index,
-                field, payload))
+                GLOBAL_TRACER.task(self.client.import_local),
+                self.by_id[nid].host, index, field, payload))
             if idx is not None:
                 f = idx.field(field)
                 if f is not None:
@@ -2451,13 +2495,25 @@ class Cluster:
                 res = cluster.api.executor.execute(
                     args["index"], Query(calls), shards or [],
                     translate=False)
-                return {"results": [result_to_wire(r) for r in res],
-                        "execS": time.perf_counter() - t0,
-                        # post-execution gen summary: lets the coordinator
-                        # key its cross-node result-cache entries to the
-                        # data this answer was computed from
-                        "gens": list(gen_summary(cluster.holder,
-                                                 args["index"]))}
+                out = {"results": [result_to_wire(r) for r in res],
+                       "execS": time.perf_counter() - t0,
+                       # post-execution gen summary: lets the coordinator
+                       # key its cross-node result-cache entries to the
+                       # data this answer was computed from
+                       "gens": list(gen_summary(cluster.holder,
+                                                args["index"]))}
+                # span summaries piggyback like the gen summaries: the
+                # handler collected this request's finished spans (and
+                # its own in-flight HTTP span) so the coordinator can
+                # adopt them into one cluster-wide trace tree
+                spans = getattr(req, "_span_collect", None)
+                if spans is not None:
+                    spans = list(spans)
+                    hs = getattr(req, "_trace_span", None)
+                    if hs is not None and hs.sampled:
+                        spans.append(hs.to_dict())
+                    out["spans"] = spans
+                return out
             call = call_from_wire(body["call"])
             result = cluster._local_exec(args["index"], call, shards or [])
             return {"result": result_to_wire(result)}

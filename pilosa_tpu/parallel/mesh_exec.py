@@ -46,6 +46,7 @@ so concurrent staging cannot evict them mid-use (docs/memory-budget.md).
 
 from __future__ import annotations
 
+import time as _time
 from concurrent import futures
 
 import jax
@@ -56,8 +57,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core import SHARD_WORDS
 from ..ops import bsi
 from ..executor.plan import eval_plan, parametrize, plan_inputs
+from ..utils import profile as qprof
 from ..utils.deadline import check_current
 from ..utils.faults import FAULTS
+from ..utils.tracing import GLOBAL_TRACER
 
 # shard_map moved from jax.experimental (kwarg check_rep) to the jax
 # namespace (kwarg check_vma) across jax releases; gate on what this
@@ -1143,18 +1146,36 @@ class _ShardSchedule:
             raise
         return pinned
 
+    def _slice_event(self, prof, i, sl, t0, up0, ev0):
+        """One per-shard-slice profile stage: dispatch wall time plus the
+        device-budget upload/evict deltas the slice drove — the
+        streaming half of the EXPLAIN ANALYZE tree
+        (docs/observability.md)."""
+        budget = self.mexec._budget
+        prof.event("device.slice", _time.perf_counter() - t0,
+                   slice=i, shards=len(sl),
+                   uploadBytes=budget.upload_bytes - up0,
+                   evictions=budget.evictions - ev0)
+
     def __iter__(self):
         # Deadline + failpoint gate per slice: an expired query aborts
         # BETWEEN shard slices (check_current raises DeadlineExceeded;
         # the finally below releases pins, so partial device work is
         # freed, docs/robustness.md) instead of running to completion.
+        prof = qprof.current()
+        budget = self.mexec._budget
         if len(self.slices) <= 1:
             for sl in self.slices:
                 FAULTS.hit("mesh.slice", key=self.index)
                 check_current("mesh shard slice")
-                yield sl
+                if prof is None:
+                    yield sl
+                else:
+                    t0, up0, ev0 = (_time.perf_counter(),
+                                    budget.upload_bytes, budget.evictions)
+                    yield sl
+                    self._slice_event(prof, 0, sl, t0, up0, ev0)
             return
-        budget = self.mexec._budget
         pool = self.mexec._uploader_pool()
         fut = None   # in-flight prefetch of the slice about to be served
         pins: list = []
@@ -1162,6 +1183,8 @@ class _ShardSchedule:
             for i, sl in enumerate(self.slices):
                 FAULTS.hit("mesh.slice", key=self.index)
                 check_current("mesh shard slice")
+                t0, up0, ev0 = (_time.perf_counter(), budget.upload_bytes,
+                                budget.evictions)
                 if fut is not None:
                     # prefetch-hit means the uploader finished BEFORE the
                     # consumer got here (checked via done() — result()
@@ -1184,10 +1207,18 @@ class _ShardSchedule:
                 # cold slices stage here; prefetched ones hit the cache
                 pins.extend(self._stage(sl))
                 if i + 1 < len(self.slices):
-                    fut = pool.submit(self._stage, self.slices[i + 1])
+                    # the trace context crosses the uploader-pool
+                    # boundary with the prefetch (orphan staging work
+                    # would otherwise be untraceable)
+                    fut = pool.submit(
+                        GLOBAL_TRACER.task(self._stage,
+                                           name="mesh.prefetch_slice"),
+                        self.slices[i + 1])
                 yield sl
                 # the consumer dispatched against this slice between the
                 # yield and here — safe to let the budget rotate it out
+                if prof is not None:
+                    self._slice_event(prof, i, sl, t0, up0, ev0)
                 for k in pins:
                     budget.unpin(k)
                 pins = []

@@ -12,15 +12,18 @@ from __future__ import annotations
 
 import json
 import re
+import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from .. import __version__
 from ..api import API, ApiError, ConflictError, DisallowedError, NotFoundError
+from ..utils import profile as qprof
 from ..utils.deadline import (DEADLINE_HEADER, DeadlineExceeded,
                               QueryContext, activate)
-from ..utils.tracing import GLOBAL_TRACER, TRACE_HEADER
+from ..utils.tracing import (GLOBAL_TRACER, PROBE_HEADER, TRACE_HEADER,
+                             parse_trace_header)
 from ..executor import RowResult, ValCount, RowIdentifiers
 from ..executor.results import GroupCount, Pair
 from .admission import AdmissionRejected
@@ -41,6 +44,25 @@ def serialize_result(r) -> object:
             return [g.to_dict() for g in r]
         return [serialize_result(x) for x in r]
     return r
+
+
+from contextlib import nullcontext as _nullcontext
+
+_NULL_CTX = _nullcontext()
+
+
+def _profile_shards(node: dict):
+    """Best-effort shard count from a profile tree: the first stage
+    tagged with one (the executor's dispatch stage, or a fan-out peer
+    event on the coordinator)."""
+    tags = node.get("tags") or {}
+    if "shards" in tags:
+        return tags["shards"]
+    for c in node.get("children", ()):
+        n = _profile_shards(c)
+        if n is not None:
+            return n
+    return None
 
 
 class ClientAbort(Exception):
@@ -274,6 +296,12 @@ def build_router(api: API, server=None) -> Router:
         armed = FAULTS.snapshot()
         if armed:
             out["failpoints"] = armed
+        slog = getattr(server, "slowlog", None) if server is not None \
+            else None
+        if slog is not None:
+            out["slowLog"] = {"thresholdS": slog.threshold_s,
+                              "size": slog.size,
+                              "recorded": slog.recorded}
         return out
 
     def metrics(req, args):
@@ -294,6 +322,18 @@ def build_router(api: API, server=None) -> Router:
         return {"spans": GLOBAL_TRACER.spans(tid)}
 
     r.add("GET", "/debug/traces", debug_traces)
+
+    def debug_slow(req, args):
+        """Slow-query log ring (docs/observability.md): queries that ran
+        past slow-query-threshold, newest last, each with its trace id
+        and profile tree for drill-down via /debug/traces."""
+        slog = getattr(server, "slowlog", None) if server is not None \
+            else None
+        if slog is None:
+            return {"thresholdS": 0, "entries": []}
+        return slog.snapshot()
+
+    r.add("GET", "/debug/slow", debug_slow)
 
     # -- pprof-style profiling (handler.go:280 /debug/pprof) ---------------
 
@@ -406,6 +446,12 @@ class _HandlerClass(BaseHTTPRequestHandler):
     admission_internal = None
     default_query_timeout: float = 0.0
     stats = None
+    # Observability (docs/observability.md).  slowlog: SlowQueryLog ring
+    # capturing queries past slow-query-threshold (None = off).
+    # profile_default: return the stage-timing tree on every query even
+    # without ?profile=true.
+    slowlog = None
+    profile_default: bool = False
 
     # request helpers
     def json(self):
@@ -456,13 +502,31 @@ class _HandlerClass(BaseHTTPRequestHandler):
             return
         self.body = self.rfile.read(length) if length > 0 else b""
         fn, args, gate = self.router.match(method, parsed.path)
-        trace_id = self.headers.get(TRACE_HEADER)  # handler.go:231 extract
+        # handler.go:231 extract — the header carries
+        # trace_id:parent_span_id[:0], so a remote hop's spans parent
+        # under the coordinator's rpc span (docs/observability.md)
+        tid, parent_id, sampled = parse_trace_header(
+            self.headers.get(TRACE_HEADER))
+        # Probe/background tagging: health probes (wire-tagged by
+        # InternalClient) and the status/metrics/debug surfaces never
+        # reach the latency histograms or the slow-query log — background
+        # cadence must not pollute p99.
+        background = (self.headers.get(PROBE_HEADER) is not None
+                      or parsed.path in ("/status", "/metrics")
+                      or parsed.path.startswith("/debug/"))
         ctx = None
+        status = 200
+        prof = None
+        want_profile = False
+        trace_out = None
+        t_req0 = time.perf_counter()
         try:
             if fn is None:
+                status = 404
                 self._send(404, {"error": f"path not found: {parsed.path}"})
                 return
             if fn == "method_not_allowed":
+                status = 405
                 self._send(405, {"error": "method not allowed"})
                 return
             # Deadline: an internal hop's header (the coordinator's
@@ -483,18 +547,50 @@ class _HandlerClass(BaseHTTPRequestHandler):
                 budget = self.default_query_timeout
             if budget is not None and budget > 0:
                 ctx = QueryContext(budget)
+            # Per-query profile (utils/profile.py): collected when the
+            # client asked for one (?profile=true / profile-default) OR
+            # the slow-query log is on (slow entries carry the tree);
+            # embedded in the response only when requested.
+            if gate == "query":
+                want_profile = (self._query.get("profile", [""])[0]
+                                == "true" or self.profile_default)
+                if want_profile or (self.slowlog is not None
+                                    and self.slowlog.enabled):
+                    prof = qprof.QueryProfile()
             adm = self.admission if gate == "query" else \
                 self.admission_internal if gate == "internal" else None
             admitted = False
             if adm is not None:
-                adm.acquire()  # raises AdmissionRejected -> 503
+                # slot wait is the first profile stage: under overload
+                # it IS the latency story
+                with (prof.stage("admission") if prof is not None
+                      else _NULL_CTX):
+                    adm.acquire()  # raises AdmissionRejected -> 503
                 admitted = True
             try:
+                # /internal/ continuations collect this request's
+                # finished spans so /internal/query can piggyback them
+                # back to the coordinator (cluster.py reads these attrs)
+                collect = [] if (tid is not None
+                                 and parsed.path.startswith("/internal/")) \
+                    else None
                 with activate(ctx):
                     if ctx is not None:
                         ctx.check("admission")
-                    with GLOBAL_TRACER.span(f"{method} {parsed.path}",
-                                            trace_id=trace_id):
+                    # background requests with no inbound trace must not
+                    # root new sampled traces: probe cadence x peers
+                    # would continuously evict real query traces from
+                    # the bounded span ring
+                    root_sampled = sampled if tid is not None \
+                        else (False if background else None)
+                    with GLOBAL_TRACER.span(
+                            f"{method} {parsed.path}", trace_id=tid,
+                            parent_id=parent_id, sampled=root_sampled,
+                            collect=collect) as span, \
+                            qprof.activate(prof):
+                        self._trace_span = span
+                        self._span_collect = collect
+                        trace_out = span.trace_id
                         out = fn(self, args)
             finally:
                 if admitted:
@@ -504,12 +600,24 @@ class _HandlerClass(BaseHTTPRequestHandler):
                 self._send_raw(200, ctype, payload.encode()
                                if isinstance(payload, str) else payload)
             else:
-                self._send(200, out)
+                resp_headers = None
+                if gate == "query" and trace_out is not None:
+                    # echo the trace id so any client can jump straight
+                    # to /debug/traces?trace=<id>
+                    resp_headers = {TRACE_HEADER: trace_out}
+                if want_profile and prof is not None:
+                    prof.finish()
+                    out = dict(out)
+                    out["traceID"] = trace_out
+                    out["profile"] = prof.to_dict()
+                self._send(200, out, headers=resp_headers)
         except AdmissionRejected as e:
             # overload/drain rejection: bounded, explicit, retryable
+            status = 503
             self._send(503, {"error": str(e)},
                        headers={"Retry-After": str(e.retry_after)})
         except DeadlineExceeded as e:
+            status = 504
             if self.stats is not None:
                 self.stats.count("query.deadline_abort")
             body = {"error": str(e)}
@@ -518,20 +626,53 @@ class _HandlerClass(BaseHTTPRequestHandler):
                 body["budgetS"] = ctx.budget
             self._send(504, body)
         except NotFoundError as e:
+            status = 404
             self._send(404, {"error": str(e)})
         except ConflictError as e:
+            status = 409
             self._send(409, {"error": str(e)})
         except DisallowedError as e:
+            status = 400
             self._send(400, {"error": str(e)})
         except ClientAbort:
             # the client hung up mid-response: already counted, nothing
             # left to send — just let the connection close
-            pass
+            status = 499
         except (ApiError, ValueError) as e:
+            status = 400
             self._send(400, {"error": str(e)})
         except Exception as e:  # panic guard (handler.go:325 recover)
+            status = 500
             traceback.print_exc()
             self._send(500, {"error": f"internal error: {e}"})
+        finally:
+            self._observe(gate, args, time.perf_counter() - t_req0,
+                          status, background, prof, trace_out)
+
+    def _observe(self, gate, args, dur_s, status, background, prof,
+                 trace_id):
+        """Post-request accounting (docs/observability.md): latency
+        histograms + the slow-query log.  Background traffic (probes,
+        status/metrics/debug) was tagged by the caller and is excluded
+        from both."""
+        if background:
+            return
+        if self.stats is not None:
+            self.stats.timing("http.request", dur_s)
+            if gate == "query":
+                self.stats.timing("http.query", dur_s)
+        slog = self.slowlog
+        if (gate == "query" and slog is not None and slog.enabled
+                and dur_s >= slog.threshold_s):
+            profile = shards = None
+            if prof is not None:
+                prof.finish()
+                profile = prof.to_dict()
+                shards = _profile_shards(profile)
+            slog.record(index=args.get("index", ""),
+                        query=self.body.decode("utf-8", "replace"),
+                        duration_s=dur_s, shards=shards,
+                        trace_id=trace_id, status=status, profile=profile)
 
     def _send(self, code: int, obj, headers: dict | None = None):
         self._send_raw(code, "application/json",
@@ -625,6 +766,7 @@ def make_http_server(api: API, host: str = "localhost", port: int = 10101,
                      max_body_bytes_internal: int | None = None,
                      admission=None, admission_internal=None,
                      default_query_timeout: float | None = None,
+                     slowlog=None, profile_default: bool | None = None,
                      ) -> ThreadingHTTPServer:
     """``tls``: optional (certificate, key, ca_certificate|None) paths —
     serves HTTPS, requiring client certificates (mutual TLS) when a CA is
@@ -645,6 +787,10 @@ def make_http_server(api: API, host: str = "localhost", port: int = 10101,
         attrs["admission_internal"] = admission_internal
     if default_query_timeout is not None:
         attrs["default_query_timeout"] = default_query_timeout
+    if slowlog is not None:
+        attrs["slowlog"] = slowlog
+    if profile_default is not None:
+        attrs["profile_default"] = profile_default
     cls = type("Handler", (_HandlerClass,), attrs)
     if tls is None:
         return TrackingHTTPServer((host, port), cls)

@@ -112,6 +112,20 @@ class Config:
     # Distinct rows a batched write may touch before a fragment's rank
     # cache stops updating incrementally and rebuilds lazily instead.
     rank_rebuild_rows: int = 4096
+    # -- observability (docs/observability.md) -----------------------------
+    # Queries slower than this (seconds) land in the slow-query log ring
+    # (/debug/slow) with their trace id + profile tree, and are emitted
+    # as structured log lines.  0 disables the log.
+    slow_query_threshold: float = 1.0
+    # Entries kept in the slow-query ring buffer.
+    slow_log_size: int = 128
+    # Return the per-query profile tree on EVERY query response, not just
+    # those with ?profile=true (an always-on EXPLAIN ANALYZE).
+    profile_default: bool = False
+    # Fraction of trace ROOTS recorded to the span ring buffer; the
+    # decision propagates to children and across the wire, so a trace is
+    # recorded everywhere or nowhere.  1.0 = always-on (Dapper-style).
+    trace_sample_rate: float = 1.0
     verbose: bool = False
 
     @classmethod
@@ -166,6 +180,12 @@ class Config:
             "PILOSA_TPU_FAILPOINTS": ("failpoints", str),
             "PILOSA_TPU_RESULT_CACHE_MB": ("result_cache_mb", int),
             "PILOSA_TPU_RANK_REBUILD_ROWS": ("rank_rebuild_rows", int),
+            "PILOSA_TPU_SLOW_QUERY_THRESHOLD": ("slow_query_threshold",
+                                                float),
+            "PILOSA_TPU_SLOW_LOG_SIZE": ("slow_log_size", int),
+            "PILOSA_TPU_PROFILE_DEFAULT": (
+                "profile_default", lambda s: s == "true"),
+            "PILOSA_TPU_TRACE_SAMPLE_RATE": ("trace_sample_rate", float),
         }
         for env, (attr, conv) in env_map.items():
             if env in os.environ:
@@ -204,6 +224,10 @@ class Config:
             "failpoints": "failpoints",
             "result-cache-mb": "result_cache_mb",
             "rank-rebuild-rows": "rank_rebuild_rows",
+            "slow-query-threshold": "slow_query_threshold",
+            "slow-log-size": "slow_log_size",
+            "profile-default": "profile_default",
+            "trace-sample-rate": "trace_sample_rate",
         }
         for key, attr in mapping.items():
             if key in doc:
@@ -309,13 +333,26 @@ class Server:
         self.admission_internal = AdmissionController(
             self.config.max_queries, self.config.queue_timeout,
             stats=self.stats, name="internal")
+        # Observability (docs/observability.md): the slow-query ring +
+        # the trace-sampling decision.  The tracer is process-wide like
+        # the memory budgets — the most recent Server's config wins.
+        from ..utils.slowlog import SlowQueryLog
+        from ..utils.tracing import GLOBAL_TRACER
+        GLOBAL_TRACER.sample_rate = min(
+            max(self.config.trace_sample_rate, 0.0), 1.0)
+        self.slowlog = SlowQueryLog(
+            threshold_s=self.config.slow_query_threshold,
+            size=self.config.slow_log_size,
+            logger=self.logger, stats=self.stats)
         self.httpd = make_http_server(
             self.api, host, port, server=self, tls=tls,
             max_body_bytes=self.config.max_body_mb << 20,
             max_body_bytes_internal=self.config.max_body_internal_mb << 20,
             admission=self.admission,
             admission_internal=self.admission_internal,
-            default_query_timeout=self.config.query_timeout)
+            default_query_timeout=self.config.query_timeout,
+            slowlog=self.slowlog,
+            profile_default=self.config.profile_default)
         from ..utils.diagnostics import DiagnosticsCollector
         self.diagnostics = DiagnosticsCollector(
             self, self.config.diagnostics_endpoint,

@@ -27,6 +27,16 @@ class Logger:
     def error(self, msg: str):
         self._emit("ERROR", msg)
 
+    def event(self, name: str, **fields):
+        """Structured log line — ``<ts> INFO <name> k=v k=v ...`` with
+        stable key order — so operators can grep/join machine-readably.
+        The slow-query log emits these with ``trace=<id>``, correlating
+        log lines to /debug/traces (docs/observability.md)."""
+        parts = " ".join(
+            f"{k}={v!r}" if isinstance(v, str) else f"{k}={v}"
+            for k, v in fields.items())
+        self._emit("INFO", f"{name} {parts}" if parts else name)
+
 
 class NopLogger(Logger):
     def _emit(self, level: str, msg: str):

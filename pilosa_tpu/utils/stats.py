@@ -2,7 +2,13 @@
 
 In-process counters/gauges/timings with tag support; snapshot() feeds both
 the expvar-style /debug/vars JSON and the Prometheus text exposition at
-/metrics (reference prometheus/prometheus.go)."""
+/metrics (reference prometheus/prometheus.go).
+
+Timings are fixed LOG-BUCKET histograms (docs/observability.md): O(1)
+memory per series over a server's lifetime like the old [count, sum]
+aggregation, but able to answer p50/p95/p99 (Monarch/Prometheus-style
+bucketed latency distributions) and exported as proper Prometheus
+``_bucket``/``_sum``/``_count`` histogram series at /metrics."""
 
 from __future__ import annotations
 
@@ -10,25 +16,87 @@ import threading
 import time
 from collections import defaultdict
 
+# Inclusive upper edges for timing histograms: 1-2.5-5 per decade from
+# 100 µs to 100 s (values above land in +Inf).  Fixed and shared by every
+# series so /metrics stays aggregatable across nodes.
+TIMING_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+)
+
+
+class _Hist:
+    """One timing series: count, sum, and per-bucket counters over the
+    shared TIMING_BUCKETS edges.  Mutated under the owning client's
+    lock."""
+
+    __slots__ = ("count", "total", "buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.buckets = [0] * (len(TIMING_BUCKETS) + 1)
+
+    def observe(self, v: float):
+        self.count += 1
+        self.total += v
+        for i, b in enumerate(TIMING_BUCKETS):
+            if v <= b:
+                self.buckets[i] += 1
+                return
+        self.buckets[-1] += 1
+
+    def percentile(self, q: float) -> float | None:
+        """Order statistic estimated from the buckets with linear
+        interpolation inside the winning bucket (the histogram_quantile
+        formula) — deterministic given the recorded values, so golden-
+        value testable."""
+        if self.count == 0:
+            return None
+        target = q * self.count
+        cum = 0
+        lo = 0.0
+        for i, hi in enumerate(TIMING_BUCKETS):
+            prev = cum
+            cum += self.buckets[i]
+            if cum >= target:
+                if self.buckets[i] == 0:
+                    return hi
+                frac = (target - prev) / self.buckets[i]
+                return lo + frac * (hi - lo)
+            lo = hi
+        return TIMING_BUCKETS[-1]  # +Inf bucket: clamp to the last edge
+
 
 class StatsClient:
+    # Distinct values tracked per set_value() name before further values
+    # collapse into one ":__other__" series: set_value feeds gauges, and
+    # an unbounded dynamic value (client-chosen strings) must not grow
+    # the gauge map — and /metrics — without bound.
+    SET_VALUE_CAP = 64
+
     def __init__(self, tags: list[str] | None = None):
         self.tags = tags or []
         self._lock = threading.Lock()
         self._counts: dict[str, float] = defaultdict(float)
         self._gauges: dict[str, float] = {}
-        # aggregated [count, sum] — NOT raw samples: always-on per-query
-        # timings must stay O(1) memory over a server's lifetime
-        self._timings: dict[str, list[float]] = defaultdict(
-            lambda: [0, 0.0])
+        # per-series log-bucket histograms — NOT raw samples: always-on
+        # per-query timings must stay O(1) memory over a server's lifetime
+        self._timings: dict[str, _Hist] = defaultdict(_Hist)
+        # distinct values seen per set_value name (cardinality cap)
+        self._set_values: dict[str, set] = defaultdict(set)
 
     def with_tags(self, *tags: str) -> "StatsClient":
         child = StatsClient(self.tags + list(tags))
+        self._share_with(child)
+        return child
+
+    def _share_with(self, child: "StatsClient"):
         child._lock = self._lock  # shared metrics need the shared lock
         child._counts = self._counts
         child._gauges = self._gauges
         child._timings = self._timings
-        return child
+        child._set_values = self._set_values
 
     def _key(self, name: str) -> str:
         if not self.tags:
@@ -45,16 +113,27 @@ class StatsClient:
 
     def timing(self, name: str, value_s: float, rate: float = 1.0):
         with self._lock:
-            t = self._timings[self._key(name)]
-            t[0] += 1
-            t[1] += value_s
+            self._timings[self._key(name)].observe(value_s)
 
     def histogram(self, name: str, value: float, rate: float = 1.0):
         self.timing(name, value, rate)
 
+    def percentile(self, name: str, q: float) -> float | None:
+        """q-quantile (0..1) of a recorded timing/histogram series, or
+        None when nothing has been recorded under ``name``."""
+        with self._lock:
+            h = self._timings.get(self._key(name))
+            return None if h is None else h.percentile(q)
+
     def set_value(self, name: str, value: str, rate: float = 1.0):
         with self._lock:
-            self._gauges[self._key(name) + ":" + value] = 1
+            key = self._key(name)
+            seen = self._set_values[key]
+            if value not in seen:
+                if len(seen) >= self.SET_VALUE_CAP:
+                    value = "__other__"
+                seen.add(value)
+            self._gauges[key + ":" + value] = 1
 
     class _Timer:
         def __init__(self, client, name):
@@ -73,9 +152,12 @@ class StatsClient:
     def snapshot(self) -> dict:
         with self._lock:
             timings = {
-                k: {"count": v[0], "sum": v[1],
-                    "mean": v[1] / v[0] if v[0] else 0}
-                for k, v in self._timings.items()
+                k: {"count": h.count, "sum": h.total,
+                    "mean": h.total / h.count if h.count else 0,
+                    "p50": h.percentile(0.50),
+                    "p95": h.percentile(0.95),
+                    "p99": h.percentile(0.99)}
+                for k, h in self._timings.items()
             }
             return {"counts": dict(self._counts),
                     "gauges": dict(self._gauges),
@@ -83,7 +165,10 @@ class StatsClient:
 
     def prometheus_text(self) -> str:
         """Prometheus exposition format for /metrics
-        (prometheus/prometheus.go:40)."""
+        (prometheus/prometheus.go:40).  Timings export as histogram
+        families: cumulative ``_bucket{le=...}`` series over the shared
+        TIMING_BUCKETS edges plus ``_sum``/``_count``, so p99 is
+        derivable with histogram_quantile."""
         lines = []
 
         def fmt(name):
@@ -92,17 +177,32 @@ class StatsClient:
             return base + ("{" + tags if tags else "")
 
         snap = self.snapshot()
+        with self._lock:
+            hists = {k: (h.count, h.total, list(h.buckets))
+                     for k, h in self._timings.items()}
         for k, v in sorted(snap["counts"].items()):
             lines.append(f"# TYPE {fmt(k).split('{')[0]} counter")
             lines.append(f"{fmt(k)} {v}")
         for k, v in sorted(snap["gauges"].items()):
             lines.append(f"# TYPE {fmt(k).split('{')[0]} gauge")
             lines.append(f"{fmt(k)} {v}")
-        for k, t in sorted(snap["timings"].items()):
-            base = fmt(k).split("{")[0]
-            lines.append(f"# TYPE {base}_seconds summary")
-            lines.append(f"{base}_seconds_count {t['count']}")
-            lines.append(f"{base}_seconds_sum {t['sum']}")
+        for k, (count, total, buckets) in sorted(hists.items()):
+            full = fmt(k)
+            base, _, tags = full.partition("{")
+            tags = tags.rstrip("}")  # series tags, merged with le below
+            prefix = ",".join(t for t in (tags,) if t)
+            lines.append(f"# TYPE {base}_seconds histogram")
+            cum = 0
+            for edge, c in zip(TIMING_BUCKETS, buckets):
+                cum += c
+                lbl = f'{prefix},le="{edge}"' if prefix else f'le="{edge}"'
+                lines.append(f"{base}_seconds_bucket{{{lbl}}} {cum}")
+            cum += buckets[-1]
+            lbl = f'{prefix},le="+Inf"' if prefix else 'le="+Inf"'
+            lines.append(f"{base}_seconds_bucket{{{lbl}}} {cum}")
+            suffix = "{" + prefix + "}" if prefix else ""
+            lines.append(f"{base}_seconds_sum{suffix} {total}")
+            lines.append(f"{base}_seconds_count{suffix} {count}")
         return "\n".join(lines) + "\n"
 
 
@@ -205,10 +305,7 @@ class StatsdClient(StatsClient):
     def with_tags(self, *tags: str) -> "StatsdClient":
         child = StatsdClient(*self._addr, tags=self.tags + list(tags),
                              sock=self._sock)
-        child._lock = self._lock
-        child._counts = self._counts
-        child._gauges = self._gauges
-        child._timings = self._timings
+        self._share_with(child)
         return child
 
     def _send(self, payload: str):
@@ -231,6 +328,12 @@ class StatsdClient(StatsClient):
         super().timing(name, value_s, rate)
         self._send(f"{name}:{value_s * 1e3:.3f}|ms")
 
+    def histogram(self, name: str, value: float, rate: float = 1.0):
+        # record in-process via the BASE timing (bucketed, feeds
+        # /metrics + percentile) but wire as a statsd histogram, not ms
+        StatsClient.timing(self, name, value, rate)
+        self._send(f"{name}:{value}|h")
+
     def set_value(self, name: str, value: str, rate: float = 1.0):
         super().set_value(name, value, rate)
         self._send(f"{name}:{value}|s")
@@ -252,6 +355,11 @@ def make_stats_client(service: str = "expvar", host: str = "localhost:8125"
 
 
 class NopStatsClient(StatsClient):
+    """Discards everything but keeps the FULL StatsClient surface —
+    histogram/percentile/set_value included — so a no-op-configured
+    server never AttributeErrors on an instrumentation site.  percentile
+    and snapshot answer from the (empty) shared state via the base."""
+
     def count(self, *a, **k):
         pass
 
@@ -259,4 +367,10 @@ class NopStatsClient(StatsClient):
         pass
 
     def timing(self, *a, **k):
+        pass
+
+    def histogram(self, *a, **k):
+        pass
+
+    def set_value(self, *a, **k):
         pass

@@ -46,6 +46,61 @@ if [ -n "$bad" ]; then
     exit 1
 fi
 
+# Metrics-docs lint (docs/observability.md): every stats metric name in
+# the tree must appear in the catalog, and every catalog row must match a
+# call site — the catalog is the operator's contract, and a dangling row
+# or an undocumented series are both drift.  Dynamic f-string segments
+# in code and <...> placeholders in the docs both normalize to "*".
+python - <<'PYEOF'
+import fnmatch
+import pathlib
+import re
+import sys
+
+root = pathlib.Path("pilosa_tpu")
+code: set[str] = set()
+CALL = re.compile(
+    r'[a-z_]*stats\.(?:count|gauge|timing|timer|histogram)\(\s*(f?)"([^"]+)"',
+    re.S)
+HELPER = re.compile(r"\b_count\(")  # dotted-name prefix helpers
+NAME = re.compile(r'"([a-z0-9_]+(?:\.[a-z0-9_{}.]+)+)"')
+for path in root.rglob("*.py"):
+    text = path.read_text()
+    for is_f, name in CALL.findall(text):
+        if is_f:
+            name = re.sub(r"\{[^}]*\}", "*", name)
+        code.add(name)
+    for m in HELPER.finditer(text):
+        # capture every dotted literal near the helper call (covers
+        # conditional-expression names like "a.hit" if ... else "a.miss")
+        for name in NAME.findall(text[m.end():m.end() + 160]):
+            code.add(re.sub(r"\{[^}]*\}", "*", name))
+
+doc_text = pathlib.Path("docs/observability.md").read_text()
+m = re.search(r"<!-- metrics-catalog:begin -->(.*?)"
+              r"<!-- metrics-catalog:end -->", doc_text, re.S)
+if not m:
+    sys.exit("FAIL: docs/observability.md is missing the "
+             "metrics-catalog markers")
+docs = {re.sub(r"<[^>]*>", "*", n)
+        for n in re.findall(r"^\| `([^`]+)`", m.group(1), re.M)}
+
+undocumented = sorted(
+    c for c in code if not any(fnmatch.fnmatch(c, d) for d in docs))
+dangling = sorted(
+    d for d in docs if not any(fnmatch.fnmatch(c, d) for c in code))
+if undocumented:
+    print("FAIL: metric names missing from the docs/observability.md "
+          "catalog:")
+    print("  " + "\n  ".join(undocumented))
+if dangling:
+    print("FAIL: docs/observability.md catalog rows matching no call "
+          "site:")
+    print("  " + "\n  ".join(dangling))
+if undocumented or dangling:
+    sys.exit(1)
+PYEOF
+
 # committed bytecode/cache artifacts must never land in the tree
 bad=$(git ls-files | grep -E "__pycache__|\.pyc$" || true)
 if [ -n "$bad" ]; then

@@ -37,3 +37,9 @@ def test_bench_smoke_resident_and_budgeted():
     assert data["http_batch"]["fused_launches"] > 0
     assert data["http_batch"]["qps_on"] > 0 \
         and data["http_batch"]["qps_off"] > 0
+    # observability leg (docs/observability.md): profile-off serving
+    # stays within 5% of the batching leg (asserted in bench.py) and
+    # profile-on returned a populated stage tree + resolvable trace
+    assert data["observability"]["qps"] > 0
+    assert data["observability"]["profile_stages"] > 0
+    assert data["observability"]["slow_recorded"] >= 1
