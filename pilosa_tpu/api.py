@@ -377,6 +377,14 @@ class API:
         out.update({"state": state, "nodes": nodes, "epoch": epoch,
                     "localID": nodes[0]["id"] if self.cluster is None
                     else self.cluster.node_id})
+        # Storage health: quarantined fragments degrade this node (empty
+        # reads + refused writes on those fragments) but do NOT take it
+        # down — replica repair heals them while everything else serves.
+        quarantined = self.holder.quarantined_fragments()
+        out["storage"] = {
+            "quarantinedFragments": len(quarantined),
+            "degraded": bool(quarantined),
+        }
         return out
 
     def info(self) -> dict:

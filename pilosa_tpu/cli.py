@@ -167,6 +167,24 @@ def cmd_export(args) -> int:
     return 0
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _fail_stop_opens():
+    """Offline check/inspect must REPORT corruption, not quarantine it:
+    disable quarantine-on-corruption (and its sidecar-marker side
+    effect) for the duration so a bad file raises like it always did."""
+    from .storage import fragment as fragment_mod
+
+    prev = fragment_mod.QUARANTINE_ON_CORRUPTION
+    fragment_mod.QUARANTINE_ON_CORRUPTION = False
+    try:
+        yield
+    finally:
+        fragment_mod.QUARANTINE_ON_CORRUPTION = prev
+
+
 def cmd_check(args) -> int:
     """Offline fragment file integrity check (ctl/check.go:28-135)."""
     import numpy as np
@@ -175,17 +193,18 @@ def cmd_check(args) -> int:
     from .storage.fragment import Fragment
 
     ok = True
-    for path in args.files:
-        if path.endswith(".wal"):
-            continue
-        try:
-            frag = Fragment(path, "check", "check", "check", 0)
-            n = int(np.unique(frag._idx // SHARD_WORDS).size)
-            print(f"{path}: OK rows_with_data={n}")
-            frag.close()
-        except Exception as e:
-            ok = False
-            print(f"{path}: CORRUPT {e}")
+    with _fail_stop_opens():
+        for path in args.files:
+            if path.endswith(".wal"):
+                continue
+            try:
+                frag = Fragment(path, "check", "check", "check", 0)
+                n = int(np.unique(frag._idx // SHARD_WORDS).size)
+                print(f"{path}: OK rows_with_data={n}")
+                frag.close()
+            except Exception as e:
+                ok = False
+                print(f"{path}: CORRUPT {e}")
     return 0 if ok else 1
 
 
@@ -196,18 +215,20 @@ def cmd_inspect(args) -> int:
     from .core import SHARD_WORDS
     from .storage.fragment import Fragment
 
-    for path in args.files:
-        frag = Fragment(path, "inspect", "inspect", "inspect", 0)
-        n_bits = int(np.bitwise_count(frag._val).sum())
-        rows_used = int(np.unique(frag._idx // SHARD_WORDS).size)
-        total_bits = frag.n_rows * SHARD_WORDS * 32
-        density = n_bits / total_bits if total_bits else 0.0
-        print(json.dumps({
-            "path": path, "rows": frag.n_rows, "rowsWithData": rows_used,
-            "bits": n_bits, "density": round(density, 6),
-            "sizeBytes": frag.host_bytes(),
-        }))
-        frag.close()
+    with _fail_stop_opens():
+        for path in args.files:
+            frag = Fragment(path, "inspect", "inspect", "inspect", 0)
+            n_bits = int(np.bitwise_count(frag._val).sum())
+            rows_used = int(np.unique(frag._idx // SHARD_WORDS).size)
+            total_bits = frag.n_rows * SHARD_WORDS * 32
+            density = n_bits / total_bits if total_bits else 0.0
+            print(json.dumps({
+                "path": path, "rows": frag.n_rows,
+                "rowsWithData": rows_used,
+                "bits": n_bits, "density": round(density, 6),
+                "sizeBytes": frag.host_bytes(),
+            }))
+            frag.close()
     return 0
 
 
@@ -230,6 +251,11 @@ max-op-n = 10000
 # queue-timeout = 0.5      # seconds to wait for a slot before 503
 # breaker-threshold = 5    # consecutive peer failures -> circuit open
 # drain-seconds = 5        # graceful-drain budget on shutdown
+# durability & recovery (docs/robustness.md)
+# wal-crc = true           # CRC-frame new WAL files (torn-tail recovery)
+# quarantine-on-corruption = true  # corrupt fragment -> quarantine +
+#                          # replica repair instead of failing startup
+# repair-interval = 60     # seconds between quarantine-repair sweeps
 # observability (docs/observability.md)
 # slow-query-threshold = 1 # seconds before a query lands in /debug/slow
 # slow-log-size = 128      # slow-query ring-buffer entries
@@ -277,6 +303,10 @@ def cmd_config(args) -> int:
     print(f"breaker-threshold = {cfg.breaker_threshold}")
     print(f"drain-seconds = {cfg.drain_seconds}")
     print(f"health-down-threshold = {cfg.health_down_threshold}")
+    print(f"wal-crc = {str(cfg.wal_crc).lower()}")
+    print(f"quarantine-on-corruption = "
+          f"{str(cfg.quarantine_on_corruption).lower()}")
+    print(f"repair-interval = {cfg.repair_interval}")
     print(f"slow-query-threshold = {cfg.slow_query_threshold}")
     print(f"slow-log-size = {cfg.slow_log_size}")
     print(f"profile-default = {str(cfg.profile_default).lower()}")

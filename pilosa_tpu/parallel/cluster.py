@@ -50,6 +50,7 @@ from ..executor.results import (
 )
 from ..pql import Call, Query, parse
 from ..pql.wire import call_from_wire, call_to_wire
+from ..utils import degraded
 from ..utils import profile as qprof
 from ..utils.deadline import DEADLINE_HEADER, current as current_ctx
 from ..utils.faults import FAULTS
@@ -58,6 +59,12 @@ from .placement import Placement
 
 NODE_READY = "READY"
 NODE_DOWN = "DOWN"
+
+
+def _wall_stamp() -> float: return time.time()  # display-only wall clock
+# (anti-entropy last-error/last-success stamps shown to operators; every
+# DURATION in this module still comes from perf_counter pairs — see the
+# scripts/check.sh timing lint, which excludes this helper by name)
 
 STATE_STARTING = "STARTING"
 STATE_NORMAL = "NORMAL"
@@ -514,8 +521,12 @@ class InternalClient:
         # summaries below): fold them into the local ring so
         # /debug/traces on the coordinator renders the whole cluster tree
         GLOBAL_TRACER.adopt(out.get("spans"))
+        # 4th element: the peer's quarantined-fragment count for this
+        # index — the coordinator folds it into the response's degraded
+        # flag (utils/degraded.py)
         return ([result_from_wire(r) for r in out["results"]],
-                float(out.get("execS", 0.0)), out.get("gens"))
+                float(out.get("execS", 0.0)), out.get("gens"),
+                int(out.get("quarantined", 0)))
 
     def send_message(self, host: str, msg: dict,
                      timeout: float | None = None):
@@ -536,12 +547,16 @@ class InternalClient:
         return out.get("shards", [])
 
     def fragment_blocks(self, host: str, index: str, field: str, view: str,
-                        shard: int) -> dict[int, str]:
+                        shard: int) -> tuple[dict[int, str], bool]:
+        """(block checksums, peer-quarantined flag).  A quarantined
+        peer's empty block map must NOT enter merge consensus — its
+        emptiness is corruption fallout, not a legitimate clear."""
         out = self._json(
             host, "GET",
             f"/internal/fragment/blocks?index={index}&field={field}"
             f"&view={view}&shard={shard}")
-        return {int(k): v for k, v in out.get("blocks", {}).items()}
+        return ({int(k): v for k, v in out.get("blocks", {}).items()},
+                bool(out.get("quarantined", False)))
 
     def block_data(self, host: str, index: str, field: str, view: str,
                    shard: int, block: int) -> tuple[np.ndarray, np.ndarray]:
@@ -591,6 +606,21 @@ class InternalClient:
             f"&view={view}&shard={shard}")
         if status >= 400:
             raise ClusterError(f"fragment data fetch failed: {status}")
+        return data
+
+    def fragment_fetch(self, host: str, index: str, field: str, view: str,
+                       shard: int) -> bytes:
+        """Whole-fragment fetch as CHECKSUMMED native snapshot bytes
+        (quarantine repair; docs/robustness.md).  The caller verifies the
+        embedded CRCs on receipt (Fragment.restore_snapshot_bytes) — a
+        flip in flight or on the peer's side must not launder itself into
+        a 'repaired' fragment."""
+        status, data = self._request(
+            host, "GET",
+            f"/internal/fragment/fetch?index={index}&field={field}"
+            f"&view={view}&shard={shard}")
+        if status >= 400:
+            raise ClusterError(f"fragment fetch failed: {status}")
         return data
 
 
@@ -803,6 +833,16 @@ class Cluster:
         self._peer_data_ver: dict[tuple[str, str], int] = {}
         self._peer_gen_seen: dict[tuple[str, str], tuple] = {}
         self._gen_lock = threading.Lock()
+        # Anti-entropy observability (docs/robustness.md): failures as
+        # DATA, not just a log line — counters ride self.stats
+        # (antientropy.errors / antientropy.repairs), and the last
+        # error/success land here for /debug/vars.  _ae_lock is a leaf
+        # lock.
+        self.stats = stats
+        self._ae_lock = threading.Lock()
+        self._ae_last_error: str | None = None
+        self._ae_last_error_ts: float | None = None
+        self._ae_last_success_ts: float | None = None
         self._load_topology()
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * len(self.nodes)))
@@ -1050,14 +1090,20 @@ class Cluster:
             self._remote_shards.pop(index, None)
 
     def _available_shards(self, index: str,
-                          mark_down: bool = True) -> list[int]:
+                          mark_down: bool = True,
+                          on_error=None) -> list[int]:
         """Union of local + peer available shards.  The reference gossips
         per-field available-shard bitmaps (field.go:263); with static
         membership we ask peers directly and fold the answer into
         remote-known shards so it converges without re-asking.
         ``mark_down=False`` for read-only informational callers (e.g.
         /internal/shards/max): a transient peer timeout there must not
-        flip the cluster DEGRADED."""
+        flip the cluster DEGRADED.  ``on_error``: optional
+        ``(node_id, exc)`` callback — the anti-entropy pass surfaces
+        these swallowed failures as DATA (a peer poll failing here marks
+        the node DOWN, which silently empties every later peer loop in
+        the pass; without the callback the whole pass would look like a
+        clean no-op success)."""
         idx = self.holder.index(index)
         shards = set(idx.available_shards()) if idx is not None else set()
         for n in self.peers():
@@ -1065,7 +1111,9 @@ class Cluster:
                 continue
             try:
                 got = self.client.available_shards(n.host, index)
-            except Exception:
+            except Exception as e:
+                if on_error is not None:
+                    on_error(n.id, e)
                 if mark_down:
                     self._mark_down(n.id)
                 continue
@@ -1316,7 +1364,13 @@ class Cluster:
             pending = []
             for nid, (nshards, t0, fut) in futures.items():
                 try:
-                    res, exec_s, peer_gens = fut.result()
+                    res, exec_s, peer_gens, peer_quarantined = fut.result()
+                    if peer_quarantined:
+                        # peer answered with quarantined fragments serving
+                        # empty: surface it on THIS response (consumed on
+                        # the request thread, where the handler's
+                        # degraded collector is active)
+                        degraded.note(peer_quarantined)
                     elapsed = time.perf_counter() - t0
                     stats.timing("cluster.multi.peer_exec", exec_s)
                     stats.timing("cluster.multi.wire_overhead",
@@ -1841,33 +1895,105 @@ class Cluster:
     # -- anti-entropy (holder.go:909 holderSyncer; fleshed out with the
     # block-merge protocol in storage/fragment blocks/block_data) ----------
 
+    def _note_ae_error(self, context: str, exc: BaseException):
+        """Anti-entropy failure as DATA (docs/robustness.md): counter +
+        last-error surface, whether or not the pass continues."""
+        if self.stats is not None:
+            self.stats.count("antientropy.errors")
+        with self._ae_lock:
+            self._ae_last_error = f"{context}: {exc}"
+            self._ae_last_error_ts = _wall_stamp()
+
+    def _note_ae_success(self):
+        if self.stats is not None:
+            self.stats.count("antientropy.runs")
+        with self._ae_lock:
+            self._ae_last_success_ts = _wall_stamp()
+
+    def ae_snapshot(self) -> dict:
+        """Anti-entropy health for /debug/vars (counters live in the
+        stats counts; this carries the last-error/last-success surface)."""
+        with self._ae_lock:
+            return {
+                "lastError": self._ae_last_error,
+                "lastErrorTs": self._ae_last_error_ts,
+                "lastSuccessTs": self._ae_last_success_ts,
+            }
+
     def sync_holder(self):
-        """Anti-entropy pass (holder.go:938 SyncHolder): for every owned
-        fragment, compare 100-row block checksums with replicas and run the
-        union-MAJORITY merge — consensus-set bits are added, consensus-clear
-        bits are CLEARED (no resurrection), and peers whose value disagrees
-        with consensus get repairs PUSHED to them (fragment.go:1875
-        mergeBlock + :2941 syncFragment).  Attr stores sync by block diff
+        """Anti-entropy pass (holder.go:938 SyncHolder): first heal any
+        QUARANTINED local fragments wholesale from a healthy replica
+        (repair_quarantined), then for every owned fragment, compare
+        100-row block checksums with replicas and run the union-MAJORITY
+        merge — consensus-set bits are added, consensus-clear bits are
+        CLEARED (no resurrection), and peers whose value disagrees with
+        consensus get repairs PUSHED to them (fragment.go:1875 mergeBlock
+        + :2941 syncFragment).  Attr stores sync by block diff
         (holder.go:1002-1096).  Also re-runs the holder cleaner: post-
         resize fragment GC is deferred (see _apply_resize_complete), and
         the AE cadence is its periodic backstop (holder.go:1131)."""
         from ..storage.roaring_io import unpack_roaring
 
-        if self.state != STATE_RESIZING:
-            self._holder_cleaner()
-        holder = self.holder
-        for index_name, idx in list(holder.indexes.items()):
-            shards = self._available_shards(index_name)
-            for fname, f in list(idx.fields.items()):
-                for s in shards:
-                    owners = self.placement.shard_nodes(index_name, s)
-                    if self.node_id not in owners:
-                        continue
-                    for vname in list(f.views) or ["standard"]:
-                        self._sync_fragment(index_name, fname, vname, s,
-                                            owners, unpack_roaring)
-        self._sync_attrs()
-        self._sync_translate_entries()
+        try:
+            self.repair_quarantined()
+            if self.state != STATE_RESIZING:
+                self._holder_cleaner()
+            holder = self.holder
+            for index_name, idx in list(holder.indexes.items()):
+                shards = self._available_shards(
+                    index_name,
+                    on_error=lambda nid, e, i=index_name: self._note_ae_error(
+                        f"shard poll for {i} from {nid}", e))
+                for fname, f in list(idx.fields.items()):
+                    for s in shards:
+                        owners = self.placement.shard_nodes(index_name, s)
+                        if self.node_id not in owners:
+                            continue
+                        for vname in list(f.views) or ["standard"]:
+                            self._sync_fragment(index_name, fname, vname, s,
+                                                owners, unpack_roaring)
+            self._sync_attrs()
+            self._sync_translate_entries()
+        except Exception as e:
+            self._note_ae_error("sync_holder", e)
+            raise
+        self._note_ae_success()
+
+    # -- quarantine repair (docs/robustness.md "Replica repair") -----------
+
+    def repair_quarantined(self) -> int:
+        """Re-fetch every quarantined local fragment wholesale from a
+        healthy replica: checksummed snapshot bytes over
+        /internal/fragment/fetch, CRC-verified on receipt, atomically
+        swapped in via the durable-replace path, generation bumped (so
+        result caches keyed on the gen vector invalidate).  Returns the
+        number repaired; failures count antientropy.errors and are
+        retried next pass."""
+        repaired = 0
+        if self.holder is None:
+            return 0
+        for iname, fname, vname, shard, frag in \
+                list(self.holder.iter_fragments()):
+            if frag.quarantined is None:
+                continue
+            owners = self.placement.shard_nodes(iname, shard)
+            for nid, host in self._ready_peer_hosts(owners):
+                try:
+                    blob = self.client.fragment_fetch(
+                        host, iname, fname, vname, shard)
+                    frag.restore_snapshot_bytes(blob)
+                except Exception as e:
+                    # unreachable peer, peer also quarantined (409), or
+                    # corrupt bytes in flight (CRC mismatch on receipt)
+                    self._note_ae_error(
+                        f"repair {iname}/{fname}/{vname}/{shard} "
+                        f"from {nid}", e)
+                    continue
+                repaired += 1
+                if self.stats is not None:
+                    self.stats.count("antientropy.repairs")
+                break
+        return repaired
 
     def _sync_translate_entries(self):
         """Replica key-table catch-up: pull new translate entries from the
@@ -1887,8 +2013,9 @@ class Cluster:
                 if isinstance(ts, RemoteTranslateStore):
                     try:
                         ts.sync_entries()
-                    except Exception:
-                        pass  # next pass retries
+                    except Exception as e:
+                        self._note_ae_error("translate sync", e)
+                        # next pass retries
 
     def _ready_peer_hosts(self, node_ids) -> list[tuple[str, str]]:
         return [(nid, self.by_id[nid].host) for nid in node_ids
@@ -1898,6 +2025,11 @@ class Cluster:
     def _sync_fragment(self, index: str, field: str, view: str, shard: int,
                        owners: list[str], unpack_roaring):
         local = self.holder.fragment(index, field, view, shard)
+        if local is not None and local.quarantined is not None:
+            # repair_quarantined (start of this pass) couldn't heal it
+            # yet: its empty store must not feed the consensus merge —
+            # that would CLEAR healthy replicas with corruption fallout
+            return
         # hex digests to match the wire encoding of fragment_blocks
         local_blocks = {b: ck.hex() for b, ck in local.blocks().items()} \
             if local is not None else {}
@@ -1905,10 +2037,17 @@ class Cluster:
         remote_blocks = {}
         for nid, host in self._ready_peer_hosts(owners):
             try:
-                remote_blocks[nid] = self.client.fragment_blocks(
+                blocks, peer_quarantined = self.client.fragment_blocks(
                     host, index, field, view, shard)
-            except Exception:
+            except Exception as e:
+                self._note_ae_error(
+                    f"blocks {index}/{field}/{view}/{shard} from {nid}", e)
                 continue
+            if peer_quarantined:
+                # same rule for peers: a quarantined replica is excluded
+                # from consensus entirely (its own repair pass heals it)
+                continue
+            remote_blocks[nid] = blocks
             peers.append((nid, host))
         if not peers:
             return
@@ -1962,7 +2101,10 @@ class Cluster:
             try:
                 rows, cols = self.client.block_data(
                     host, index, field, view, shard, block)
-            except Exception:
+            except Exception as e:
+                self._note_ae_error(
+                    f"block_data {index}/{field}/{view}/{shard}"
+                    f"#{block} from {nid}", e)
                 continue
             flats.append(rows * SHARD_WIDTH + cols)
             got_peers.append((nid, host))
@@ -2002,8 +2144,12 @@ class Cluster:
                     host, index, field, view, shard,
                     decode(p_sets), decode(p_clears))
                 self.note_peer_write(index, [nid])
-            except Exception:
-                continue  # peer repair is best-effort; next pass retries
+            except Exception as e:
+                # peer repair is best-effort; next pass retries
+                self._note_ae_error(
+                    f"block_repair {index}/{field}/{view}/{shard}"
+                    f"#{block} to {nid}", e)
+                continue
 
     # -- attr anti-entropy (holder.go:1002-1096 syncIndex/syncField) -------
 
@@ -2023,7 +2169,9 @@ class Cluster:
             try:
                 attrs = self.client.attr_diff(host, index, field,
                                               local_blocks)
-            except Exception:
+            except Exception as e:
+                self._note_ae_error(
+                    f"attr_diff {index}/{field or 'columns'} from {nid}", e)
                 continue
             if attrs:
                 store.set_bulk_attrs(attrs)
@@ -2502,6 +2650,13 @@ class Cluster:
                        # data this answer was computed from
                        "gens": list(gen_summary(cluster.holder,
                                                 args["index"]))}
+                # quarantined fragments answered as EMPTY: piggyback the
+                # count so the coordinator's response says so
+                # (utils/degraded.py, docs/robustness.md)
+                nq = len(cluster.holder.quarantined_fragments(
+                    args["index"]))
+                if nq:
+                    out["quarantined"] = nq
                 # span summaries piggyback like the gen summaries: the
                 # handler collected this request's finished spans (and
                 # its own in-flight HTTP span) so the coordinator can
@@ -2596,6 +2751,10 @@ class Cluster:
             frag = _frag(req)
             if frag is None:
                 return {"blocks": {}}
+            if frag.quarantined is not None:
+                # the empty block map is corruption fallout, not data:
+                # flag it so callers exclude this replica from consensus
+                return {"blocks": {}, "quarantined": True}
             return {"blocks": {str(b): ck.hex()
                                for b, ck in frag.blocks().items()}}
 
@@ -2622,6 +2781,10 @@ class Cluster:
                 return {}
             frag = f._create_view_if_not_exists(body["view"]) \
                 .create_fragment_if_not_exists(int(body["shard"]))
+            if frag.quarantined is not None:
+                # block diffs can't heal a quarantined fragment (and its
+                # writes are refused); wholesale repair will restore it
+                return {}
             sr = np.asarray(body.get("setRows", []), dtype=np.int64)
             sc = np.asarray(body.get("setCols", []), dtype=np.int64)
             cr = np.asarray(body.get("clearRows", []), dtype=np.int64)
@@ -2658,9 +2821,14 @@ class Cluster:
         router.add("POST", "/internal/attr/diff", attr_diff)
 
         def fragment_data(req, args):
+            from ..api import ConflictError
             from ..storage.roaring_io import pack_roaring
             from ..ops import bitset
             frag = _frag(req)
+            if frag is not None and frag.quarantined is not None:
+                # a resize/bootstrap copy from a quarantined source would
+                # propagate its emptiness cluster-wide as if it were data
+                raise ConflictError("fragment quarantined")
             if frag is None:
                 rows = cols = np.zeros(0, dtype=np.int64)
             else:
@@ -2668,6 +2836,20 @@ class Cluster:
             return ("application/octet-stream", pack_roaring(rows, cols))
 
         router.add("GET", "/internal/fragment/data", fragment_data)
+
+        def fragment_fetch(req, args):
+            """Checksummed whole-fragment snapshot bytes — the replica
+            repair source (docs/robustness.md).  Refuses for missing or
+            quarantined fragments: repair must converge on HEALTHY data."""
+            from ..api import ConflictError, NotFoundError
+            frag = _frag(req)
+            if frag is None:
+                raise NotFoundError("fragment not found")
+            if frag.quarantined is not None:
+                raise ConflictError("fragment quarantined")
+            return ("application/octet-stream", frag.snapshot_bytes())
+
+        router.add("GET", "/internal/fragment/fetch", fragment_fetch)
 
         def fragment_list(req, args):
             index = req.query.get("index", [""])[0]

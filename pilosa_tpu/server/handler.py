@@ -19,6 +19,8 @@ from urllib.parse import parse_qs, urlparse
 
 from .. import __version__
 from ..api import API, ApiError, ConflictError, DisallowedError, NotFoundError
+from ..storage.fragment import FragmentQuarantinedError
+from ..utils import degraded
 from ..utils import profile as qprof
 from ..utils.deadline import (DEADLINE_HEADER, DeadlineExceeded,
                               QueryContext, activate)
@@ -168,8 +170,17 @@ def build_router(api: API, server=None) -> Router:
         shards = None
         if "shards" in req.query:
             shards = [int(s) for s in req.query["shards"][0].split(",")]
-        results = api.query(args["index"], query, shards)
+        # Degraded-state collection (utils/degraded.py): quarantined
+        # fragments answer as EMPTY — the response must say so.  The
+        # coordinator notes peer-reported counts during fan-out; the
+        # local holder's count is added here.
+        with degraded.collect() as deg:
+            results = api.query(args["index"], query, shards)
+            degraded.note(
+                len(api.holder.quarantined_fragments(args["index"])))
         out = {"results": [serialize_result(x) for x in results]}
+        if deg["quarantinedFragments"]:
+            out["degraded"] = dict(deg)
         # top-level ColumnAttrSets, deduplicated by column id across the
         # query's calls like the reference's single set
         # (http/response.go QueryResponse)
@@ -302,9 +313,24 @@ def build_router(api: API, server=None) -> Router:
             out["slowLog"] = {"thresholdS": slog.threshold_s,
                               "size": slog.size,
                               "recorded": slog.recorded}
+        # durability & recovery (docs/robustness.md): quarantine state,
+        # torn-tail/repair event counters, anti-entropy health
+        from ..storage.fragment import storage_events
+        out["storage"] = {
+            "events": storage_events(),
+            "quarantined": api.holder.quarantined_fragments(),
+            "corruptAttrStores": api.holder.corrupt_attr_stores(),
+        }
+        if server is not None:
+            server.update_storage_gauges()
+            if getattr(server, "cluster", None) is not None:
+                out["storage"]["antiEntropy"] = server.cluster.ae_snapshot()
         return out
 
     def metrics(req, args):
+        if server is not None:
+            # refresh the storage.* gauges so scrapes see current values
+            server.update_storage_gauges()
         text = api.stats.prometheus_text()
         # the batcher's histogram/summary series don't fit the stats
         # client's counter/gauge model; it exports its own lines
@@ -625,6 +651,14 @@ class _HandlerClass(BaseHTTPRequestHandler):
                 body["elapsedS"] = round(ctx.elapsed(), 4)
                 body["budgetS"] = ctx.budget
             self._send(504, body)
+        except FragmentQuarantinedError as e:
+            # write refused on a quarantined fragment: RETRYABLE —
+            # replica repair restores it on the repair-interval cadence
+            status = 503
+            if self.stats is not None:
+                self.stats.count("storage.write_refused")
+            self._send(503, {"error": str(e), "retryable": True},
+                       headers={"Retry-After": "30"})
         except NotFoundError as e:
             status = 404
             self._send(404, {"error": str(e)})

@@ -104,6 +104,24 @@ class Config:
     # Failpoint spec armed at startup (utils/faults.py syntax); empty =
     # nothing armed.  For chaos tests and game-days only.
     failpoints: str = ""
+    # -- durability & recovery (docs/robustness.md) ------------------------
+    # Frame new WAL files with length+CRC records so torn tails are
+    # detected and truncated at a record boundary on replay.  Off writes
+    # the legacy bare record stream (old-reader compatibility /
+    # differential testing); existing files always keep THEIR format
+    # until the next snapshot truncation.
+    wal_crc: bool = True
+    # A corrupt snapshot/WAL quarantines the fragment — empty reads with
+    # a degraded flag, writes refused with a retryable 503, replica
+    # repair heals it — instead of raising out of startup.  Off restores
+    # fail-stop opens (debugging / single-node forensics).
+    quarantine_on_corruption: bool = True
+    # Seconds between dedicated quarantine-repair sweeps (re-fetch
+    # quarantined fragments wholesale from a healthy replica).  The
+    # anti-entropy pass also repairs on its own cadence; this knob keeps
+    # the time-to-heal well under anti-entropy-interval.  0 disables the
+    # dedicated sweep.
+    repair_interval: float = 60.0
     # -- query cache subsystem (docs/caching.md) ---------------------------
     # Host-byte budget for the generation-keyed result cache (LRU); 0
     # disables it.  Off by default so chaos/overload exercises hit the
@@ -178,6 +196,10 @@ class Config:
             "PILOSA_TPU_HEALTH_DOWN_THRESHOLD": ("health_down_threshold",
                                                  int),
             "PILOSA_TPU_FAILPOINTS": ("failpoints", str),
+            "PILOSA_TPU_WAL_CRC": ("wal_crc", lambda s: s != "false"),
+            "PILOSA_TPU_QUARANTINE_ON_CORRUPTION": (
+                "quarantine_on_corruption", lambda s: s != "false"),
+            "PILOSA_TPU_REPAIR_INTERVAL": ("repair_interval", float),
             "PILOSA_TPU_RESULT_CACHE_MB": ("result_cache_mb", int),
             "PILOSA_TPU_RANK_REBUILD_ROWS": ("rank_rebuild_rows", int),
             "PILOSA_TPU_SLOW_QUERY_THRESHOLD": ("slow_query_threshold",
@@ -201,9 +223,9 @@ class Config:
     def from_toml(cls, path: str, **overrides) -> "Config":
         """Precedence: TOML file < PILOSA_TPU_* env < explicit kwargs
         (reference cmd/root.go:60 setAllConfig)."""
-        import tomllib
+        from ..utils import toml
         with open(path, "rb") as f:
-            doc = tomllib.load(f)
+            doc = toml.load(f)
         cfg = cls()
         mapping = {
             "data-dir": "data_dir", "bind": "bind", "max-op-n": "max_op_n",
@@ -222,6 +244,9 @@ class Config:
             "drain-seconds": "drain_seconds",
             "health-down-threshold": "health_down_threshold",
             "failpoints": "failpoints",
+            "wal-crc": "wal_crc",
+            "quarantine-on-corruption": "quarantine_on_corruption",
+            "repair-interval": "repair_interval",
             "result-cache-mb": "result_cache_mb",
             "rank-rebuild-rows": "rank_rebuild_rows",
             "slow-query-threshold": "slow_query_threshold",
@@ -270,6 +295,13 @@ class Server:
             if self.config.host_stage_mb > 0
             else (0 if self.config.host_stage_mb == 0 else None))
         HOST_STAGE_BUDGET.shrink_to_limit()
+        # Durability knobs are process-wide module flags on the fragment
+        # codec (same most-recent-Server-wins convention as the budgets):
+        # they govern file OPENS, which happen under holder.open() below.
+        from ..storage import fragment as _fragment
+        _fragment.WAL_CRC = bool(self.config.wal_crc)
+        _fragment.QUARANTINE_ON_CORRUPTION = bool(
+            self.config.quarantine_on_corruption)
         data_dir = os.path.expanduser(self.config.data_dir)
         self.holder = Holder(
             data_dir, max_op_n=self.config.max_op_n,
@@ -389,6 +421,10 @@ class Server:
                                  daemon=True)
             t.start()
             self._threads.append(t)
+        if self.cluster is not None and self.config.repair_interval > 0:
+            t = threading.Thread(target=self._monitor_repair, daemon=True)
+            t.start()
+            self._threads.append(t)
         if self.config.metric_poll_interval > 0:
             t = threading.Thread(target=self._monitor_runtime, daemon=True)
             t.start()
@@ -438,6 +474,7 @@ class Server:
         self.stats.gauge("runtime.hbm_pinned_bytes", b["pinnedBytes"])
         self.stats.gauge("runtime.host_stage_bytes",
                          HOST_STAGE_BUDGET.resident_bytes)
+        self.update_storage_gauges()
         # admission slot/queue occupancy (counters live in stats counts)
         for pool in (self.admission, self.admission_internal):
             s = pool.snapshot()
@@ -459,6 +496,36 @@ class Server:
                 self.cluster.sync_holder()
             except Exception as e:
                 self.logger.error(f"anti-entropy sync failed: {e}")
+
+    def _monitor_repair(self):
+        """Dedicated quarantine-repair sweep (docs/robustness.md): a
+        corrupt fragment heals on the repair-interval cadence instead of
+        waiting out the (much longer) anti-entropy interval.  Cheap when
+        healthy — one holder scan finding nothing."""
+        while not self._closing.wait(self.config.repair_interval):
+            try:
+                if self.holder.quarantined_fragments():
+                    n = self.cluster.repair_quarantined()
+                    if n:
+                        self.logger.info(
+                            f"repaired {n} quarantined fragment(s) "
+                            f"from replicas")
+            except Exception as e:
+                self.logger.error(f"quarantine repair failed: {e}")
+
+    def update_storage_gauges(self):
+        """Durability counters -> stats gauges (referenced from the
+        fragment codec's module docs): called on the metric poll AND from
+        the /metrics and /debug/vars handlers so scrapes see current
+        values, not poll-stale ones."""
+        from ..storage.fragment import storage_events
+        ev = storage_events()
+        self.stats.gauge("storage.quarantine_events", ev["quarantine"])
+        self.stats.gauge("storage.torn_wal_recoveries",
+                         ev["torn_tail_recovered"])
+        self.stats.gauge("storage.repairs", ev["repair"])
+        self.stats.gauge("storage.quarantined_fragments",
+                         len(self.holder.quarantined_fragments()))
 
     def drain(self, timeout: float | None = None) -> bool:
         """Graceful drain: stop ADMITTING public queries (new ones get

@@ -22,10 +22,25 @@ class AttrStore:
     def __init__(self, path: str | None = None):
         self.path = path
         self._attrs: dict[int, dict] = {}
+        # non-None = the store file was corrupt at open; the bad bytes
+        # were moved aside and the store started empty (anti-entropy attr
+        # sync pulls the content back from peers — attrs are repairable
+        # metadata, so startup must not die on them)
+        self.corrupt: str | None = None
         self._lock = threading.RLock()
         if path is not None and os.path.exists(path):
-            with open(path) as f:
-                self._attrs = {int(k): v for k, v in json.load(f).items()}
+            try:
+                with open(path) as f:
+                    self._attrs = {int(k): v
+                                   for k, v in json.load(f).items()}
+            except (ValueError, OSError) as e:
+                self.corrupt = str(e)
+                from .fragment import _bump
+                _bump("attr_corrupt")
+                try:
+                    os.replace(path, path + ".corrupt")
+                except OSError:
+                    pass
 
     def _save(self):
         if self.path is None:

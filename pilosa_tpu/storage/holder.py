@@ -106,6 +106,55 @@ class Holder:
         v = f.view(view)
         return None if v is None else v.fragment(shard)
 
+    def iter_fragments(self, index: str | None = None):
+        """Yield (index, field, view, shard, fragment) over local data
+        (optionally one index) — the quarantine/repair scan surface."""
+        items = [(index, self.indexes[index])] if index is not None \
+            and index in self.indexes else list(self.indexes.items())
+        for iname, idx in items:
+            for fname, f in list(idx.fields.items()):
+                for vname, v in list(f.views.items()):
+                    for shard, frag in list(v.fragments.items()):
+                        yield iname, fname, vname, shard, frag
+
+    def quarantined_fragments(self, index: str | None = None) -> list[dict]:
+        """Currently-quarantined fragments (docs/robustness.md): the
+        degraded-state surface for /status, /debug/vars and query
+        responses.  Called on every public query / health probe /
+        metrics scrape, so the healthy case (no quarantine has EVER
+        happened in this process) fast-outs without scanning the
+        holder."""
+        from .fragment import QUARANTINE_SEEN
+        if not QUARANTINE_SEEN:
+            return []
+        out = []
+        for iname, fname, vname, shard, frag in self.iter_fragments(index):
+            if frag.quarantined is not None:
+                out.append({"index": iname, "field": fname, "view": vname,
+                            "shard": shard, "reason": frag.quarantined})
+        return out
+
+    def corrupt_attr_stores(self, index: str | None = None) -> list[dict]:
+        """Attr stores whose JSON was corrupt at open (bad bytes moved
+        aside to ``.corrupt``, store restarted empty; attr anti-entropy
+        pulls the content back from peers).  Surfaced at /debug/vars so
+        the silent reset is visible to operators."""
+        from .fragment import storage_events
+        if storage_events()["attr_corrupt"] == 0:
+            return []  # fast-out: no attr store has EVER reset
+        items = [(index, self.indexes[index])] if index is not None \
+            and index in self.indexes else list(self.indexes.items())
+        out = []
+        for iname, idx in items:
+            if idx.column_attrs.corrupt is not None:
+                out.append({"index": iname, "field": None,
+                            "reason": idx.column_attrs.corrupt})
+            for fname, f in list(idx.fields.items()):
+                if f.row_attrs.corrupt is not None:
+                    out.append({"index": iname, "field": fname,
+                                "reason": f.row_attrs.corrupt})
+        return out
+
     def schema(self) -> list[dict]:
         """JSON-able schema (holder.go Schema)."""
         out = []

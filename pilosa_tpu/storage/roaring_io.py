@@ -26,6 +26,7 @@ import struct
 import numpy as np
 
 from ..core import SHARD_WIDTH, SHARD_WIDTH_EXP
+from ..utils.durable import checksum
 
 MAGIC = 12348
 # official-roaring interop cookies (roaring.go:5020; the reference's
@@ -42,6 +43,139 @@ RUN_MAX_SIZE = 2048    # roaring.go:1930
 
 class RoaringFormatError(ValueError):
     pass
+
+
+# -- fragment snapshot codec (docs/robustness.md "Durability & recovery") --
+#
+# The native snapshot file for Fragment's sparse word store.  Version
+# history:
+#   v2 (PTPUFRG2): header + nnz LE (flat u32, word u32) pairs — legacy,
+#       read-only, no checksums.
+#   v3 (PTPUFRG3): header + nnz LE u64 flat indices + nnz LE u32 words —
+#       legacy, read-only, no checksums (tall sparse fragments).
+#   v4 (PTPUFRG4): checksummed.  Layout:
+#       [0:24)   header  <8sIIQ>  magic, cap_rows, words/row, nnz
+#       [24:28)  <I> CRC of the header bytes — verified BEFORE nnz is
+#                trusted, so a flipped bit in nnz cannot drive a huge
+#                allocation or a bogus payload read
+#       [28:28+12*nnz)  payload: nnz LE u64 flat indices, nnz LE u32 words
+#       trailer  <I> CRC of the payload bytes
+#   The total size is fully determined by the header, so truncation and
+#   appended garbage are both detected by a length check alone.
+#
+# All versions go through unpack_snapshot(), which raises
+# SnapshotFormatError on ANY malformed input (the caller decides whether
+# that quarantines the fragment or propagates).
+
+SNAP_MAGIC_V2 = b"PTPUFRG2"
+SNAP_MAGIC_V3 = b"PTPUFRG3"
+SNAP_MAGIC_V4 = b"PTPUFRG4"
+SNAP_HEADER = struct.Struct("<8sIIQ")
+_SNAP_CRC = struct.Struct("<I")
+
+
+class SnapshotFormatError(ValueError):
+    """Malformed/corrupt fragment snapshot bytes."""
+
+
+def pack_snapshot(cap_rows: int, idx: np.ndarray, val: np.ndarray,
+                  words_per_row: int) -> bytes:
+    """Serialize a sparse word store to the checksummed v4 format."""
+    header = SNAP_HEADER.pack(SNAP_MAGIC_V4, cap_rows, words_per_row,
+                              idx.size)
+    idx_b = idx.astype("<u8").tobytes()
+    val_b = val.astype("<u4").tobytes()
+    return b"".join((
+        header,
+        _SNAP_CRC.pack(checksum(header)),
+        idx_b,
+        val_b,
+        _SNAP_CRC.pack(checksum(val_b, checksum(idx_b))),
+    ))
+
+
+def unpack_snapshot(data: bytes, words_per_row: int,
+                    row_id_cap: int | None = None
+                    ) -> tuple[int, np.ndarray, np.ndarray]:
+    """Parse any snapshot version into (cap_rows, idx int64, val uint32).
+
+    Checksums are verified for v4; v2/v3 predate them and get structural
+    validation only (exact length, sorted indices, in-range values) —
+    the lenient-load path for files written before this format existed.
+    Raises SnapshotFormatError on anything malformed."""
+    try:
+        return _unpack_snapshot(data, words_per_row, row_id_cap)
+    except SnapshotFormatError:
+        raise
+    except (struct.error, ValueError, OverflowError) as e:
+        raise SnapshotFormatError(f"malformed snapshot: {e}")
+
+
+def _unpack_snapshot(data, words_per_row, row_id_cap):
+    if len(data) < SNAP_HEADER.size:
+        raise SnapshotFormatError(
+            f"snapshot too short ({len(data)} bytes)")
+    magic, cap_rows, words, nnz = SNAP_HEADER.unpack_from(data, 0)
+    if magic not in (SNAP_MAGIC_V2, SNAP_MAGIC_V3, SNAP_MAGIC_V4):
+        raise SnapshotFormatError(f"bad snapshot magic {magic!r}")
+    if magic == SNAP_MAGIC_V4:
+        # header CRC first: nnz must not be trusted before this passes
+        if len(data) < SNAP_HEADER.size + _SNAP_CRC.size:
+            raise SnapshotFormatError("snapshot header truncated")
+        (hcrc,) = _SNAP_CRC.unpack_from(data, SNAP_HEADER.size)
+        if checksum(data[:SNAP_HEADER.size]) != hcrc:
+            raise SnapshotFormatError("snapshot header CRC mismatch")
+    if words != words_per_row:
+        raise SnapshotFormatError(
+            f"snapshot has {words} words/row, expected {words_per_row}")
+    if row_id_cap is not None and cap_rows > 2 * (row_id_cap + 1):
+        # row capacity doubles, so a legitimately-written snapshot never
+        # declares more than 2*(cap+1) rows; beyond that the header is
+        # corrupt or was written under a larger max_row_id config
+        raise SnapshotFormatError(
+            f"snapshot declares {cap_rows} rows, above the configured "
+            f"max_row_id {row_id_cap}; raise max_row_id if this data "
+            f"was written with a larger cap")
+    if magic == SNAP_MAGIC_V2:
+        want = SNAP_HEADER.size + 8 * nnz
+        if len(data) != want:
+            raise SnapshotFormatError(
+                f"snapshot is {len(data)} bytes, v2 header implies {want}")
+        pairs = np.frombuffer(data, dtype="<u4", count=2 * nnz,
+                              offset=SNAP_HEADER.size)
+        idx = pairs[0::2].astype(np.int64)
+        val = pairs[1::2].astype(np.uint32)
+    else:
+        off = SNAP_HEADER.size
+        if magic == SNAP_MAGIC_V4:
+            off += _SNAP_CRC.size
+        want = off + 12 * nnz
+        if magic == SNAP_MAGIC_V4:
+            want += _SNAP_CRC.size
+        if len(data) != want:
+            raise SnapshotFormatError(
+                f"snapshot is {len(data)} bytes, header implies {want}")
+        idx_b = data[off: off + 8 * nnz]
+        val_b = data[off + 8 * nnz: off + 12 * nnz]
+        if magic == SNAP_MAGIC_V4:
+            (pcrc,) = _SNAP_CRC.unpack_from(data, want - _SNAP_CRC.size)
+            if checksum(val_b, checksum(idx_b)) != pcrc:
+                raise SnapshotFormatError("snapshot payload CRC mismatch")
+        idx = np.frombuffer(idx_b, dtype="<u8").astype(np.int64)
+        val = np.frombuffer(val_b, dtype="<u4").astype(np.uint32)
+    # structural validation (cheap; the load-bearing defense for the
+    # un-checksummed legacy versions): indices sorted/unique/in-range,
+    # or every downstream searchsorted silently mis-answers
+    if idx.size:
+        if int(idx[0]) < 0 or int(idx[-1]) >= cap_rows * words_per_row:
+            raise SnapshotFormatError("snapshot index out of range")
+        if idx.size > 1 and not bool(np.all(np.diff(idx) > 0)):
+            raise SnapshotFormatError(
+                "snapshot indices not strictly increasing")
+    keep = val != 0
+    if not keep.all():
+        idx, val = idx[keep], val[keep]
+    return cap_rows, idx, val
 
 
 def unpack_roaring(data: bytes, row_id_cap: int | None = None

@@ -1,0 +1,42 @@
+"""Per-request degraded-state accumulator (docs/robustness.md
+"Corruption quarantine").
+
+A query that touches quarantined fragments still answers — those
+fragments contribute EMPTY rows — but the response must say so: silent
+partial answers are how corruption poisons downstream systems.  The HTTP
+handler opens a collector around query execution; the coordinator notes
+peer-reported quarantine counts as fan-out responses are consumed (on
+the request thread), the handler adds the local count, and the response
+carries a ``degraded`` object when the total is non-zero.
+
+Contextvar-based like utils/profile.py: zero cost and inert when no
+collector is active (internal hops, background work).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+
+_collector: contextvars.ContextVar[dict | None] = contextvars.ContextVar(
+    "ptpu-degraded", default=None)
+
+
+@contextlib.contextmanager
+def collect():
+    """Activate a fresh accumulator for this request; yields the dict
+    that note() mutates."""
+    acc = {"quarantinedFragments": 0}
+    token = _collector.set(acc)
+    try:
+        yield acc
+    finally:
+        _collector.reset(token)
+
+
+def note(n: int = 1):
+    """Record n quarantined fragments touched by the current request
+    (no-op outside a collector)."""
+    acc = _collector.get()
+    if acc is not None and n:
+        acc["quarantinedFragments"] += n

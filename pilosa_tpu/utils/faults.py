@@ -17,10 +17,14 @@ compact spec:
 
 Modes: ``error`` raises ``FaultInjected`` (an OSError subclass, so
 transport-level handling — client retries, circuit breakers, fan-out
-replica retry — exercises its real error paths) and ``delay:<seconds>``
-sleeps.  ``@match`` is a substring filter on the key the hit site passes
-(host+path for client requests, index name for mesh slices, file path for
-storage); ``#times`` disarms after that many triggers.
+replica retry — exercises its real error paths), ``delay:<seconds>``
+sleeps, and ``kill[:skip]`` SIGKILLs the OWN process after skipping the
+first ``skip`` hits — the crash harness's way of dying at an exact
+byte-level failpoint (mid snapshot rename, between WAL frame appends)
+instead of at a random wall-clock instant.  ``@match`` is a substring
+filter on the key the hit site passes (host+path for client requests,
+index name for mesh slices, file path for storage); ``#times`` disarms
+after that many triggers.
 
 Woven into: ``InternalClient._request`` (client.request), fragment
 snapshot/WAL writes (fragment.snapshot / fragment.wal), and the mesh
@@ -59,7 +63,7 @@ class FaultRegistry:
 
     def arm(self, name: str, mode: str = "error", arg: float = 0.0,
             match: str | None = None, times: int | None = None):
-        if mode not in ("error", "delay"):
+        if mode not in ("error", "delay", "kill"):
             raise ValueError(f"unknown failpoint mode {mode!r}")
         with self._lock:
             self._faults[name] = _Fault(mode, arg, match, times)
@@ -103,6 +107,12 @@ class FaultRegistry:
             if f.match and f.match not in key:
                 return
             f.hits += 1
+            if f.mode == "kill" and f.arg > 0:
+                # kill:skip — let the first `skip` hits through so the
+                # crash harness can die on a RANDOM later occurrence of
+                # the same failpoint, not always the first
+                f.arg -= 1
+                return
             if f.times is not None:
                 f.times -= 1
                 if f.times <= 0:
@@ -110,6 +120,13 @@ class FaultRegistry:
             mode, arg = f.mode, f.arg
         if mode == "delay":
             time.sleep(arg)
+        elif mode == "kill":
+            # kill -9 the OWN process at this exact failpoint: no atexit,
+            # no flushing, no destructors — the crash the durability
+            # contract is written against (docs/robustness.md)
+            import os
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
         else:
             raise FaultInjected(f"failpoint {name!r} injected (key={key!r})")
 

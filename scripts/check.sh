@@ -10,11 +10,14 @@ cd "$(dirname "$0")/.."
 python -m compileall -q pilosa_tpu tests scripts bench.py
 
 # time.time() is allowed only at the annotated wall-clock sites:
-# diagnostics uptime reporting and the tracing span's display-only start
-# stamp (durations there come from a perf_counter pair).
+# diagnostics uptime reporting, the tracing span's display-only start
+# stamp (durations there come from a perf_counter pair), and the
+# anti-entropy last-error/last-success stamps (_wall_stamp — operator
+# display, never subtracted).
 bad=$(grep -rn "time\.time()" pilosa_tpu bench.py \
     | grep -v "pilosa_tpu/utils/diagnostics.py" \
-    | grep -v "self\.start = time\.time()" || true)
+    | grep -v "self\.start = time\.time()" \
+    | grep -v "_wall_stamp" || true)
 if [ -n "$bad" ]; then
     echo "FAIL: wall-clock time.time() in timing code (use" \
          "time.perf_counter pairs; see utils/tracing.py):"
@@ -100,6 +103,14 @@ if dangling:
 if undocumented or dangling:
     sys.exit(1)
 PYEOF
+
+# Storage durability fast suite (docs/robustness.md "Durability &
+# recovery"): the byte-level corruption fuzz (truncate/flip at every
+# offset of snapshot+WAL -> recover-or-quarantine, never a crash) and
+# the short deterministic 2-cycle kill -9 crash harness.  The 20-cycle
+# randomized soak is pytest -m slow.
+JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' -p no:cacheprovider \
+    tests/test_durability.py tests/test_crash.py
 
 # committed bytecode/cache artifacts must never land in the tree
 bad=$(git ls-files | grep -E "__pycache__|\.pyc$" || true)

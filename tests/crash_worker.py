@@ -1,0 +1,40 @@
+"""Child process for the kill -9 crash harness (tests/test_crash.py).
+
+Runs one single-node server with a failpoint spec armed BEFORE anything
+touches disk, so kill-mode failpoints (utils/faults.py) can SIGKILL the
+process inside exact storage windows: mid WAL append, mid snapshot
+write, between the snapshot fsync and its rename, and inside the
+startup torn-tail truncation.  The parent drives write load over HTTP
+and records which writes were acknowledged; this process just serves
+until it is killed.
+
+Usage: crash_worker.py DATA_DIR BIND MAX_OP_N [FAILPOINT_SPEC]
+"""
+
+import sys
+import threading
+
+
+def main():
+    data_dir, bind, max_op_n = sys.argv[1:4]
+    spec = sys.argv[4] if len(sys.argv) > 4 else ""
+
+    # Arm BEFORE constructing the server: Server.open() arms config
+    # failpoints before holder.open(), but the spec must also cover any
+    # earlier import-time disk touches a future refactor might add.
+    from pilosa_tpu.utils.faults import FAULTS
+    if spec:
+        FAULTS.configure(spec)
+
+    from pilosa_tpu.server.server import Config, Server
+    cfg = Config(data_dir=data_dir, bind=bind, max_op_n=int(max_op_n),
+                 anti_entropy_interval=0, repair_interval=0,
+                 failpoints=spec)
+    srv = Server(cfg)
+    srv.open()
+    print(f"CRASH WORKER READY port={srv.port}", flush=True)
+    threading.Event().wait()  # serve until SIGKILL
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,28 @@ def main():
     assert jax.process_count() == nproc, jax.process_count()
     assert len(jax.devices()) == 4 * nproc
 
+    # Environment probe (ROADMAP item 3): some jaxlib builds accept
+    # distributed init on CPU but implement NO cross-process collectives
+    # — the first psum dies with "Multiprocess computations aren't
+    # implemented on the CPU backend".  Probe with a trivial collective
+    # BEFORE the heavy import machinery so unsupported environments fail
+    # fast with a distinctive marker the parent test turns into a skip
+    # (every process runs the same probe in lockstep, so none is left
+    # hanging in a half-started collective).
+    try:
+        jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+            np.ones((jax.local_device_count(),), dtype=np.float32))
+    except jax.errors.JAXTypeError:
+        raise
+    except Exception as e:  # XlaRuntimeError lives in jaxlib; match wide
+        msg = str(e).replace("\n", " ")
+        if "implemented on the CPU backend" in msg or \
+                "Multiprocess" in msg:
+            print(f"MULTIHOST UNSUPPORTED proc={pid}: {msg[:300]}",
+                  flush=True)
+            sys.exit(42)
+        raise
+
     from pilosa_tpu.core import SHARD_WIDTH
     from pilosa_tpu.executor import Executor
     from pilosa_tpu.ops import bsi

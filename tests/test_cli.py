@@ -123,8 +123,8 @@ def test_generate_config(capsys):
     assert main(["generate-config"]) == 0
     out = capsys.readouterr().out
     assert "data-dir" in out
-    import tomllib
-    tomllib.loads(out)  # valid TOML
+    from pilosa_tpu.utils import toml
+    toml.loads(out)  # valid TOML (tomllib, or tomli on py3.10)
 
 
 def test_import_create_idempotent(srv, tmp_path):

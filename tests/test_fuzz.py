@@ -125,16 +125,24 @@ def test_fuzz_wal_replay(tmp_path):
 
 
 def test_wal_torn_tail_dropped(tmp_path):
-    """A crash mid-append leaves a partial trailing record: replay drops it
-    and recovers everything before it."""
+    """A crash mid-append leaves a partial trailing FRAME (the WAL is
+    CRC-framed now — each append is one header+payload write, so a tear
+    is a prefix of that): replay drops it and recovers everything before
+    it (docs/robustness.md "Durability & recovery")."""
+    from pilosa_tpu.storage.fragment import _WAL_FRAME
+    from pilosa_tpu.utils.durable import checksum
+
     path = tmp_path / "frag"
     frag = Fragment(str(path), "i", "f", "standard", 0)
     frag.set_bit(1, 5)
     frag.set_bit(2, 6)
     frag.close()
+    payload = _OP.pack(_OP_SET, 3, 7)
+    torn = (_WAL_FRAME.pack(len(payload), checksum(payload)) + payload)[:12]
     with open(str(path) + ".wal", "ab") as f:
-        f.write(_OP.pack(_OP_SET, 3, 7)[:9])  # torn record
+        f.write(torn)  # header + 4 payload bytes: torn mid-append
     frag2 = Fragment(str(path), "i", "f", "standard", 0)
+    assert frag2.quarantined is None
     rows, cols = frag2.pairs()
     got = set(zip(rows.tolist(), cols.tolist()))
     assert got == {(1, 5), (2, 6)}

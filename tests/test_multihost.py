@@ -13,6 +13,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "multihost_worker.py")
 
@@ -50,6 +52,17 @@ def test_two_process_engine():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    # Environment sandbox (ROADMAP item 3): jaxlib builds whose CPU
+    # backend implements no cross-process collectives make this test
+    # un-runnable, not failing — the worker probes with a trivial psum
+    # right after distributed init and exits 42 with an UNSUPPORTED
+    # marker.  Skip with the real error so the reason is visible.
+    for out in outs:
+        for line in out.splitlines():
+            if "MULTIHOST UNSUPPORTED" in line:
+                pytest.skip(
+                    "XLA CPU multiprocess collectives unsupported by "
+                    f"this jaxlib: {line.split(':', 1)[-1].strip()}")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
         assert f"MULTIHOST OK proc={i}" in out, out[-2000:]
