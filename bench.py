@@ -124,12 +124,19 @@ def build_indexes():
 N_SHARDS5 = 954  # ~1B columns (954 * 2^20)
 
 
-def build_config5(rng, n_shards=N_SHARDS5):
+def build_config5(rng, n_shards=N_SHARDS5, sparse=False):
     """~1B-column index: 954 shards, an 8-row metric field (~12.5% fill)
     and a 4-row segment field (~25% fill) — SSB lineorder flag/discount
     shaped.  At these densities every 65536-column container is a roaring
     BITMAP container, so the CPU oracle's word-wise loop is the
     reference's own algorithm (roaring.go:1712).
+
+    ``sparse=True`` builds the compressed-residency variant instead
+    (docs/memory-budget.md): ~1.5% of words non-zero (scattered) plus one
+    contiguous fully-set word range per row — the clustered + scattered
+    mix of real user-id index data, where roaring would hold array/run
+    containers and the packed device form compresses ~25-30x.  Same
+    query/oracle surface either way.
 
     Rows are written densely via the Store/setRow surface
     (fragment.set_row; fragment.go setRow) — the word-level analog of
@@ -153,6 +160,12 @@ def build_config5(rng, n_shards=N_SHARDS5):
         b = rng.integers(0, 1 << 32, size=(12, SHARD_WORDS), dtype=np.uint32)
         words = a & b                      # ~25% fill
         words[4:] &= np.roll(b[4:], 7, axis=1)  # metric rows ~12.5%
+        if sparse:
+            keep = rng.random((12, SHARD_WORDS)) < 0.015
+            words *= keep
+            starts = rng.integers(0, SHARD_WORDS - 256, size=12)
+            for r in range(12):
+                words[r, starts[r]: starts[r] + 256] = 0xFFFFFFFF
         sf = seg_view.create_fragment_if_not_exists(shard)
         mf = met_view.create_fragment_if_not_exists(shard)
         for r in range(4):
@@ -413,6 +426,104 @@ def bench_config5(ex5, oracle_words, rng, budget_mb, resident):
         return out
     finally:
         DEFAULT_BUDGET.limit_bytes = old_limit
+
+
+def bench_config5_compressed(rng, n_shards=N_SHARDS5, budget_mb=768,
+                             B=32, nb=12, reps=1):
+    """The over-budget cliff, compressed vs dense (docs/memory-budget.md
+    "Compressed residency"): the SPARSE ~1B-col corpus (the data shape
+    compressed residency exists for) queried over rotating shard subsets
+    under a budget deliberately below one rotation's dense working set.
+
+    Three sub-legs on identical data and identical queries:
+      * ``resident``   — dense form, unlimited budget: the qps anchor.
+      * ``dense``      — dense form, over-budget: today's cliff (stream +
+                         evict every rotation).
+      * ``compressed`` — packed container streams under the same budget:
+                         the working set fits, rotation is free.
+    Reports compressed_mb, the effective-capacity ratio (dense bytes per
+    compressed byte actually staged), and each leg's cliff vs the
+    resident anchor."""
+    from pilosa_tpu.executor import Executor as _Ex
+    from pilosa_tpu.storage import fragment as _frag
+    from pilosa_tpu.storage.membudget import DEFAULT_BUDGET
+
+    h5, oracle_words = build_config5(rng, n_shards=n_shards, sparse=True)
+    ex = _Ex(h5, use_mesh=True)
+    old_limit = DEFAULT_BUDGET.limit_bytes
+    old_form = _frag.COMPRESSED_RESIDENT
+    subsets = [list(map(int, s))
+               for s in np.array_split(np.arange(n_shards), 4)]
+    dense_set_mb = (n_shards * 12 * 32768 * 4) >> 20
+    out = {"columns": n_shards << 20, "budget_mb": budget_mb,
+           "dense_working_set_mb": dense_set_mb, "sparse": True}
+
+    def leg(compressed, limit_mb):
+        _frag.COMPRESSED_RESIDENT = compressed
+        # flush residency from the previous leg so each leg's
+        # resident/compressed gauges describe only its own staging
+        DEFAULT_BUDGET.limit_bytes = 1
+        DEFAULT_BUDGET.shrink_to_limit()
+        DEFAULT_BUDGET.limit_bytes = \
+            None if limit_mb is None else limit_mb << 20
+        DEFAULT_BUDGET.reset_peak()
+        ev0 = DEFAULT_BUDGET.evictions
+        # hot subset alternating with rotating cold subsets — the
+        # working-set pattern that makes an over-budget dense form
+        # evict + re-stage every other batch
+        order = [subsets[0] if i % 2 == 0
+                 else subsets[1 + (i // 2) % 3] for i in range(nb)]
+        for sub in subsets:  # warm: compile + stage
+            ex.execute("ssb1b", _cfg5_batch(rng, B), shards=sub)
+
+        def run():
+            batches = [_cfg5_batch(rng, B) for _ in range(nb)]
+            return _run_batches(ex, "ssb1b", batches, 1, shards_of=order)
+
+        (qps, _bat_s, p50_s), spread = best_of(run, n=reps)
+        stats = DEFAULT_BUDGET.stats()
+        return {
+            "qps": round(qps, 1),
+            "batch_p50_ms": round(p50_s * 1e3, 1),
+            "spread": spread,
+            "evictions": DEFAULT_BUDGET.evictions - ev0,
+            "resident_mb": stats["residentBytes"] >> 20,
+            "compressed_mb": round(stats["compressedBytes"] / 2**20, 1),
+            "peak_mb": stats["peakBytes"] >> 20,
+            "budget_held": limit_mb is None or
+            stats["peakBytes"] <= (limit_mb << 20),
+        }
+
+    try:
+        # answer-equality in BOTH forms before any timing
+        q = "TopN(metric, Intersect(Row(seg=0), Row(seg=2)), n=5)"
+        want = oracle_topn5(oracle_words, range(n_shards), 0, 2)
+        for form in (False, True):
+            _frag.COMPRESSED_RESIDENT = form
+            DEFAULT_BUDGET.limit_bytes = budget_mb << 20
+            DEFAULT_BUDGET.shrink_to_limit()
+            got = ex.execute("ssb1b", q)
+            assert [(p.id, p.count) for p in got[0]] == want, \
+                f"compressed={form} answer diverged from the oracle"
+
+        out["resident"] = leg(False, None)
+        out["dense"] = leg(False, budget_mb)
+        out["compressed"] = leg(True, budget_mb)
+        anchor = out["resident"]["qps"]
+        if anchor > 0:
+            out["dense"]["cliff_vs_resident"] = round(
+                anchor / max(out["dense"]["qps"], 1e-9), 1)
+            out["compressed"]["cliff_vs_resident"] = round(
+                anchor / max(out["compressed"]["qps"], 1e-9), 1)
+        comp_mb = out["compressed"]["compressed_mb"]
+        if comp_mb > 0:
+            out["effective_capacity_ratio"] = round(
+                dense_set_mb / comp_mb, 1)
+        return out
+    finally:
+        _frag.COMPRESSED_RESIDENT = old_form
+        DEFAULT_BUDGET.limit_bytes = old_limit
+        ex.close()
 
 
 N_SHARDS5D = 256  # ~268M columns over 4 nodes
@@ -1096,6 +1207,69 @@ def run_cache_smoke(rng) -> dict:
         ex.close()
 
 
+def run_compressed_smoke(rng) -> dict:
+    """Compressed-residency leg of --smoke (docs/memory-budget.md
+    "Compressed residency"): the sparse corpus variant queried under a
+    budget well below its dense working set must (a) hold the budget,
+    (b) stage a compressed footprint smaller than the dense-resident
+    one, and (c) return results identical to the dense-resident run —
+    the three acceptance gates of the compressed path, end-to-end."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage import fragment as _frag
+    from pilosa_tpu.storage.membudget import DEFAULT_BUDGET
+
+    n_shards = 16
+    h, oracle_words = build_config5(rng, n_shards=n_shards, sparse=True)
+    ex = Executor(h, use_mesh=True)
+    old_limit = DEFAULT_BUDGET.limit_bytes
+    old_form = _frag.COMPRESSED_RESIDENT
+    batches = [_cfg5_batch(rng, 8) for _ in range(4)]
+    full_q = "TopN(metric, Intersect(Row(seg=1), Row(seg=3)), n=5)"
+    try:
+        # dense-resident reference (unlimited budget: compression is
+        # off by design there — the heuristic requires a limit)
+        _frag.COMPRESSED_RESIDENT = False
+        DEFAULT_BUDGET.limit_bytes = None
+        want = [_smoke_norm(ex.execute("ssb1b", b)) for b in batches]
+        assert _smoke_norm(ex.execute("ssb1b", full_q))[0] == \
+            oracle_topn5(oracle_words, range(n_shards), 1, 3), \
+            "dense answer diverged from the oracle"
+        dense_resident_mb = DEFAULT_BUDGET.stats()["residentBytes"] >> 20
+
+        # compressed under a budget below the dense working set
+        _frag.COMPRESSED_RESIDENT = True
+        budget = 8 << 20
+        DEFAULT_BUDGET.limit_bytes = budget
+        DEFAULT_BUDGET.shrink_to_limit()
+        DEFAULT_BUDGET.reset_peak()
+        t0 = time.perf_counter()
+        got = [_smoke_norm(ex.execute("ssb1b", b)) for b in batches]
+        compressed_s = time.perf_counter() - t0
+        assert got == want, \
+            "compressed-resident results diverged from the dense run"
+        stats = DEFAULT_BUDGET.stats()
+        assert stats["peakBytes"] <= budget, \
+            f"budget not held: peak {stats['peakBytes']} > {budget}"
+        assert stats["compressedBytes"] > 0, \
+            "no packed stream ever staged: the leg exercised nothing"
+        compressed_mb = stats["compressedBytes"] / 2**20
+        assert compressed_mb < dense_resident_mb, \
+            (f"compressed footprint {compressed_mb:.1f}MB not below the "
+             f"dense resident {dense_resident_mb}MB")
+        return {
+            "budget_held": True,
+            "compressed_mb": round(compressed_mb, 2),
+            "dense_resident_mb": dense_resident_mb,
+            "effective_capacity_ratio": round(
+                n_shards * 12 * 32768 * 4 / stats["compressedBytes"], 1),
+            "compressed_s": round(compressed_s, 2),
+        }
+    finally:
+        _frag.COMPRESSED_RESIDENT = old_form
+        DEFAULT_BUDGET.limit_bytes = old_limit
+        ex.close()
+
+
 def run_smoke():
     """--smoke: seconds-scale end-to-end exercise of the resident AND the
     budgeted/streaming query paths on tiny shard counts — wired as a
@@ -1162,6 +1336,7 @@ def run_smoke():
     finally:
         DEFAULT_BUDGET.limit_bytes = old_limit
         ex5.close()
+    out["compressed"] = run_compressed_smoke(np.random.default_rng(SEED + 6))
     out["cache"] = run_cache_smoke(np.random.default_rng(SEED + 3))
     out["overload"] = run_overload_smoke()
     out["http_batch"] = run_http_batch_smoke(np.random.default_rng(SEED + 4))
@@ -1211,6 +1386,16 @@ def main():
         cfg5 = bench_config5(ex5, oracle_words, rng, 768, resident=False)
     finally:
         ex5.close()
+    # compressed-residency leg (docs/memory-budget.md): the over-budget
+    # cliff on the sparse corpus, compressed vs dense vs resident anchor
+    try:
+        cfg5c = bench_config5_compressed(np.random.default_rng(SEED + 7))
+    except Exception as e:
+        import traceback
+        print(f"config 5 compressed leg failed: {e!r}", file=sys.stderr)
+        traceback.print_exc()
+        cfg5c = None
+
     try:
         cfg5d = bench_config5_distributed(rng)
     except Exception as e:
@@ -1279,6 +1464,8 @@ def main():
         "5_topn_1B_cols_resident": cfg5r,
         "5_topn_1B_cols_budgeted": cfg5,
     }
+    if cfg5c:
+        configs["7_topn_1B_cols_sparse_compressed"] = cfg5c
     if cfg5d:
         configs["5d_intersect_topn_4node_cluster"] = cfg5d
     if http_qps:

@@ -238,6 +238,13 @@ data-dir = "~/.pilosa_tpu"
 bind = "localhost:10101"
 max-op-n = 10000
 # max-body-mb = 1024
+# compressed residency (docs/memory-budget.md)
+# compressed-resident = true   # sparse fragments stay HBM-resident as
+#                              # packed container streams under a
+#                              # device-budget limit
+# compress-max-density = 0.5   # dense fallback: compress only below
+#                              # this fraction of the dense footprint
+# decode-workspace-mb = 1024   # per-launch dense decode ceiling
 # cross-query dynamic batching (docs/batching.md)
 # dispatch-batch = true         # fuse compatible in-flight queries
 # dispatch-batch-max = 32       # queries per fused device launch
@@ -294,6 +301,9 @@ def cmd_config(args) -> int:
     print(f"dispatch-batch-max = {cfg.dispatch_batch_max}")
     print(f"dispatch-batch-window-us = {cfg.dispatch_batch_window_us}")
     print(f"device-budget-mb = {cfg.device_budget_mb}")
+    print(f"compressed-resident = {str(cfg.compressed_resident).lower()}")
+    print(f"compress-max-density = {cfg.compress_max_density}")
+    print(f"decode-workspace-mb = {cfg.decode_workspace_mb}")
     print(f"max-body-mb = {cfg.max_body_mb}")
     print(f"result-cache-mb = {cfg.result_cache_mb}")
     print(f"rank-rebuild-rows = {cfg.rank_rebuild_rows}")

@@ -10,10 +10,16 @@ bit ``c & 31``).  A *fragment tensor* stacks rows: ``uint32[n_rows,
 SHARD_WORDS]``.  All ops here are pure jax functions over those arrays; they
 are shape-polymorphic so one jitted executable serves every fragment with the
 same row count.  The adaptive array/bitmap/run container forms of the
-reference collapse to this single dense form — on TPU the VPU processes 8x128
-lanes of uint32 per cycle and HBM streaming is the only cost, so the win from
-sparse container forms disappears while their branchy representation-dispatch
-(the (op x container-type^2) matrix) would defeat XLA fusion entirely.
+reference survive, but split across two layers: COMPUTE is always dense —
+the VPU processes 8x128 lanes of uint32 per cycle, and the branchy
+(op x container-type^2) dispatch matrix of the reference would defeat XLA
+fusion — while RESIDENCY may be compressed (ops/containers.py): sparse
+fragments stay HBM-resident as packed array/bitmap/run container streams
+and are decoded to dense tiles on device at op time, inside the same
+executable that runs these kernels.  Decode-at-op-time keeps every op
+below this line a branch-free dense kernel yet lets residency cost
+compressed bytes instead of the 100x dense blowup (docs/memory-budget.md
+"Compressed residency").
 
 Host-side packing/unpacking helpers (numpy) live at the bottom; they are the
 import/export boundary, mirroring roaring's serializer role.

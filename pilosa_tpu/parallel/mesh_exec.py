@@ -42,6 +42,20 @@ queries run at upload bandwidth instead of serialized miss latency (the
 HBM analog of the reference's page-cache read-ahead over mmap'd fragments,
 fragment.go:311).  In-use and prefetched slices are pinned in the budget
 so concurrent staging cannot evict them mid-use (docs/memory-budget.md).
+
+Compressed residency (ops/containers.py): fragments whose density
+heuristic picks the packed container form stage as stacked
+key/type/count/offset tables + payload words instead of dense tensors,
+and the compiled executables decode them to dense tiles INSIDE the
+vmapped per-shard body — decode-at-op-time, fused with the op.  The
+stacked blocks register with the budget at their compressed bytes, so
+residency, eviction, prefetch, and the slice planner are all sized by
+the compressed footprint and an over-budget dense working set becomes a
+resident compressed one.  The transient dense tiles a launch decodes are
+bounded separately: the slice planner also cuts when a slice's decoded
+bytes would exceed DECODE_WORKSPACE_BYTES, so the XLA temp buffer the
+decode reuses per launch stays small even when the whole (compressed)
+working set is resident.
 """
 
 from __future__ import annotations
@@ -72,6 +86,56 @@ else:  # jax < 0.5
     _SM_CHECK_KW = "check_rep"
 
 SHARD_AXIS = "shards"
+
+# Per-launch dense decode workspace ceiling (docs/memory-budget.md
+# "Compressed residency"): a shard slice whose compressed stacks decode
+# to more dense bytes than this is cut into smaller slices, bounding the
+# transient dense tiles one executable materialises.  Process-wide, set
+# from the server config (decode-workspace-mb) like DEFAULT_BUDGET.
+DECODE_WORKSPACE_BYTES = 1 << 30
+
+
+def _sig_rows(shape) -> int:
+    """Row count of a per-key group-signature entry — dense entries are
+    (rows, words), compressed ones ('z', rows, C, P, A, R)."""
+    return shape[1] if shape[0] == "z" else shape[0]
+
+
+def _flatten_present(present):
+    """Flatten present (key, placed, sig) entries into the device-arg
+    list a compiled executable takes: a compressed entry contributes its
+    five stacked container arrays, a dense one a single tensor.  Returns
+    (flat_args, layout); ``layout`` drives _unpack_frags inside the
+    executable and is fully determined by the entries' sigs (which key
+    the executable cache), so one compiled body always sees one layout."""
+    flat, layout = [], []
+    for k, a, s in present:
+        if isinstance(a, tuple):
+            flat.extend(a)
+            layout.append((k, len(a), s))
+        else:
+            flat.append(a)
+            layout.append((k, 1, s))
+    return flat, tuple(layout)
+
+
+def _unpack_frags(layout, arrays):
+    """Inside a per-shard (vmapped) body: decode compressed inputs to
+    dense [rows, W] tiles — the decode-at-op-time step, fused into the
+    op's own executable so dense tiles exist only as launch-local XLA
+    temporaries — and map every key to its dense fragment."""
+    from ..ops import containers
+    out = {}
+    i = 0
+    for k, n, s in layout:
+        if n == 1:
+            out[k] = arrays[i]
+        else:
+            out[k] = containers.decode_block(
+                *arrays[i: i + n], rows=s[1], words=SHARD_WORDS,
+                a_bucket=s[4], r_bucket=s[5])
+        i += n
+    return out
 
 # Multi-device collective programs must be ENQUEUED in one consistent
 # order across all device queues: two threads (concurrent server
@@ -171,25 +235,29 @@ class MeshExecutor:
         return (kind, repr(plan), tuple(input_keys), tuple(shapes),
                 tuple(extra), id(self.mesh))
 
-    def _compiled(self, slotted_plan, input_keys, shapes, reducer):
+    def _compiled(self, slotted_plan, input_keys, shapes, layout, reducer):
         """``slotted_plan`` comes from ``parametrize``: the executable is
         keyed by plan SHAPE; row ids / predicate bits ride in the params
-        vector (replicated across the mesh, P() spec)."""
+        vector (replicated across the mesh, P() spec).  ``layout`` (from
+        _flatten_present, fully determined by ``shapes``) maps the flat
+        device args back to per-key dense fragments, decoding compressed
+        entries inside the executable."""
         key = self._plan_key(reducer or "segments", slotted_plan, input_keys,
                              shapes)
         fn = self._cache.get(key)
         if fn is not None:
             return fn
+        n_args = sum(n for _, n, _ in layout)
 
         # input_keys here are only the PRESENT fragments; missing ones are
         # omitted from the arg list entirely (shard_map specs must map 1:1
         # to array args)
         def per_shard(params, *arrays):
-            frags = dict(zip(input_keys, arrays))
+            frags = _unpack_frags(layout, arrays)
             return eval_plan(slotted_plan, frags, params)
 
         vmapped = jax.vmap(per_shard,
-                           in_axes=(None,) + (0,) * len(shapes))
+                           in_axes=(None,) + (0,) * n_args)
 
         if reducer == "count":
             def block_fn(params, *arrays):
@@ -204,7 +272,8 @@ class MeshExecutor:
                 segs = vmapped(params, *arrays)    # [S_local, W]
                 return jax.lax.all_gather(segs, SHARD_AXIS, tiled=True)
 
-            in_specs = (P(),) + tuple(P(SHARD_AXIS) for _ in shapes)
+            in_specs = (P(),) + tuple(P(SHARD_AXIS)
+                                      for _ in range(n_args))
             return self._jit_shard_map(key, block_fn, in_specs, P(),
                                        check_vma=False)
         else:
@@ -213,7 +282,7 @@ class MeshExecutor:
 
             out_specs = P(SHARD_AXIS)
 
-        in_specs = (P(),) + tuple(P(SHARD_AXIS) for _ in shapes)
+        in_specs = (P(),) + tuple(P(SHARD_AXIS) for _ in range(n_args))
         return self._jit_shard_map(key, block_fn, in_specs, out_specs)
 
     # -- shard grouping ----------------------------------------------------
@@ -247,10 +316,11 @@ class MeshExecutor:
         groups: dict[tuple, list[tuple[int, list]]] = {}
         for shard, row in zip(shards, frags):
             sig = tuple(None if fr is None
-                        else (fr.n_rows, SHARD_WORDS) for fr in row)
+                        else self._frag_sig(fr) for fr in row)
             groups.setdefault(sig, []).append((shard, row))
         out = []
         nbytes = 0
+        comp_bytes = 0
         for sig, members in groups.items():
             shard_list = [m[0] for m in members]
             placed = []
@@ -259,6 +329,16 @@ class MeshExecutor:
                     placed.append(None)
                     continue
                 frs = [m[1][i] for m in members]
+                if shape[0] == "z":
+                    # compressed staging: the resident form IS the
+                    # packed stream; bytes registered below are the
+                    # compressed footprint
+                    pk = self._place_packed_block(frs, shape)
+                    pb = sum(a.nbytes for a in pk)
+                    nbytes += pb
+                    comp_bytes += pb
+                    placed.append(pk)
+                    continue
                 # Two staging paths.  Warm (mirrors already resident):
                 # stack on device — no host transfer at all.  Cold: build
                 # the dense [S, rows, W] block on host and ship it as ONE
@@ -313,20 +393,24 @@ class MeshExecutor:
             trimmed = []
             while len(self._stack_cache) > self.stack_cache_max:
                 trimmed.append(self._stack_cache.popitem(last=False)[0])
-        self._budget.register(skey, nbytes, _evict)
+        self._budget.register(skey, nbytes, _evict,
+                              compressed_bytes=comp_bytes)
         for old_key in trimmed:
             self._budget.unregister(("stack", id(self), old_key))
         return out
 
-    @staticmethod
-    def _stack_token(keys, holder, index, shards):
+    def _stack_token(self, keys, holder, index, shards):
         """(per-shard fragment rows, data-generation token) for a stacked
         block — the token keys cache validity (gens are unique per
-        mutation, so equality means identical data)."""
+        mutation, so equality means identical data).  The device form
+        rides along: a budget-limit change can flip a fragment between
+        dense and compressed residency, and a stale-form stack would
+        silently keep the old footprint."""
         frags = [[holder.fragment(index, field, view, shard)
                   for field, view in keys] for shard in shards]
-        token = tuple(-1 if fr is None else fr.gen
-                      for row in frags for fr in row)
+        token = tuple(
+            -1 if fr is None else (fr.gen, self._frag_sig(fr)[0])
+            for row in frags for fr in row)
         return frags, token
 
     def _is_resident(self, keys, holder, index, shards) -> bool:
@@ -430,6 +514,46 @@ class MeshExecutor:
         return jax.device_put(
             fill(np.zeros((bucket,) + shape, np.uint32), 0), sharding)
 
+    def _frag_sig(self, fr) -> tuple:
+        """Per-fragment group-signature entry.  Multi-process meshes pin
+        the dense form — their staging must stay deterministic across
+        processes, and remote placeholder fragments have no packed data
+        to ship."""
+        if self.multiprocess:
+            return (fr.n_rows, SHARD_WORDS)
+        return fr.device_sig()
+
+    def _place_packed_block(self, frs, sig):
+        """Compressed staging: pad each member fragment's packed
+        container stream to the group's pow2 buckets and place the five
+        stacked table/payload arrays mesh-sharded (ops/containers.py).
+        Transfers move compressed bytes, so there is no warm-mirror
+        stacking variant — re-shipping a packed stream is already far
+        cheaper than a dense stack ever was."""
+        _z, _rows, cb, pb, _ab, _rb = sig
+        n = len(frs)
+        bucket = self._bucket(n)
+        keys = np.full((bucket, cb), -1, dtype=np.int32)
+        types = np.full((bucket, cb), -1, dtype=np.int32)
+        counts = np.zeros((bucket, cb), dtype=np.int32)
+        offsets = np.zeros((bucket, cb), dtype=np.int32)
+        payload = np.zeros((bucket, pb), dtype=np.uint32)
+        for i, fr in enumerate(frs):
+            p = fr.packed_host()
+            # a concurrent write may race the signature; clamping to the
+            # signature's buckets mirrors the dense path's slice-to-shape
+            # (the stale token rebuilds the stack on the next query)
+            c = min(p.keys.size, cb)
+            pw = min(p.payload.size, pb)
+            keys[i, :c] = p.keys[:c]
+            types[i, :c] = p.types[:c]
+            counts[i, :c] = p.counts[:c]
+            offsets[i, :c] = p.offsets[:c]
+            payload[i, :pw] = p.payload[:pw]
+        sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        return tuple(jax.device_put(a, sharding)
+                     for a in (keys, types, counts, offsets, payload))
+
     @staticmethod
     def _present(keys, placed, sig):
         return [(k, a, s) for k, a, s in zip(keys, placed, sig)
@@ -456,17 +580,29 @@ class MeshExecutor:
     STREAM_SLICE_FRACTION = 0.5
 
     def _estimate_shard_bytes(self, keys, holder, index, shards):
-        """Per-shard stacked bytes over ``keys`` (bucket padding excluded:
-        this sizes slices, padding is zeros shared across them)."""
-        out = []
+        """Per-shard (resident, decode-workspace) byte estimates over
+        ``keys`` (bucket padding excluded: this sizes slices, padding is
+        zeros shared across them).  Resident counts each fragment's
+        device-resident form — compressed bytes for compressed-form
+        fragments, the dense tensor otherwise — which is what occupies
+        the budget between launches; decode counts the transient dense
+        tiles a launch materialises while decoding compressed inputs
+        (bounded separately by DECODE_WORKSPACE_BYTES)."""
+        res, dec = [], []
         for shard in shards:
-            b = 0
+            b = d = 0
             for field, view in keys:
                 fr = holder.fragment(index, field, view, shard)
                 if fr is not None:
-                    b += fr.n_rows * SHARD_WORDS * 4
-            out.append(b)
-        return out
+                    dense = fr.n_rows * SHARD_WORDS * 4
+                    nb = fr.device_nbytes() if not self.multiprocess \
+                        else dense
+                    b += nb
+                    if nb < dense:
+                        d += dense
+            res.append(b)
+            dec.append(d)
+        return res, dec
 
     def shard_schedule(self, holder, index, key_lists, shards):
         """Residency-aware shard-group schedule for a dispatch that will
@@ -491,22 +627,32 @@ class MeshExecutor:
         slices = [shards]
         if limit and not self.multiprocess and \
                 len(shards) > self.n_devices:
-            per = self._estimate_shard_bytes(all_keys, holder, index,
-                                             shards)
-            if sum(per) > limit:
+            per, dec = self._estimate_shard_bytes(all_keys, holder, index,
+                                                  shards)
+            ws = max(1, DECODE_WORKSPACE_BYTES)
+            if sum(per) > limit or sum(dec) > ws:
                 target = max(1, int(limit * self.STREAM_SLICE_FRACTION))
                 # contiguous cuts, deterministic for a given (shards,
                 # limit) so repeat queries hit the same slice cache keys;
                 # never below n_devices shards per slice — _bucket would
                 # pad a smaller slice back to a full mesh width of zero
-                # blocks, re-inflating the memory the cut tried to save
-                slices, cur, cur_b = [], [], 0
-                for s, b in zip(shards, per):
-                    if cur_b + b > target and len(cur) >= self.n_devices:
+                # blocks, re-inflating the memory the cut tried to save.
+                # Two ceilings: resident bytes against the streaming
+                # target (rotating the budget) and decoded dense bytes
+                # against the per-launch workspace — a fully-resident
+                # compressed working set still slices by the latter, so
+                # one launch never materialises more dense tiles than
+                # the workspace allows (rotation is then free: every
+                # slice's compressed stack stays resident).
+                slices, cur, cur_b, cur_d = [], [], 0, 0
+                for s, b, d in zip(shards, per, dec):
+                    if (cur_b + b > target or cur_d + d > ws) and \
+                            len(cur) >= self.n_devices:
                         slices.append(cur)
-                        cur, cur_b = [], 0
+                        cur, cur_b, cur_d = [], 0, 0
                     cur.append(s)
                     cur_b += b
+                    cur_d += d
                 if slices and len(cur) < self.n_devices:
                     slices[-1].extend(cur)  # tail can't fill the mesh
                 elif cur:
@@ -549,10 +695,12 @@ class MeshExecutor:
             if all(s is None for s in sig):
                 continue  # no fragments -> plan evaluates to empty
             present = self._present(keys, placed, sig)
+            flat, layout = _flatten_present(present)
             fn = self._compiled(slotted, tuple(k for k, _, _ in present),
-                                tuple(s for _, _, s in present), "count")
+                                tuple(s for _, _, s in present), layout,
+                                "count")
             with _DISPATCH_LOCK:
-                parts.append(fn(params, *[a for _, a, _ in present]))
+                parts.append(fn(params, *flat))
         return parts
 
     def count(self, plan, holder, index, shards) -> int:
@@ -574,10 +722,12 @@ class MeshExecutor:
                     out[shard] = zero
                 continue
             present = self._present(keys, placed, sig)
+            flat, layout = _flatten_present(present)
             fn = self._compiled(slotted, tuple(k for k, _, _ in present),
-                                tuple(s for _, _, s in present), None)
+                                tuple(s for _, _, s in present), layout,
+                                None)
             with _DISPATCH_LOCK:
-                segs = fn(params, *[a for _, a, _ in present])
+                segs = fn(params, *flat)
             # ONE addressable-shard host assembly.  Indexing the sharded
             # output per row (`segs[i]`) launched a collective reshard
             # program per shard, and per-row collectives from concurrent
@@ -615,37 +765,48 @@ class MeshExecutor:
             present = self._present(keys, placed, sig)
             pkeys = tuple(k for k, _, _ in present)
             pshapes = tuple(s for _, _, s in present)
+            flat, layout = _flatten_present(present)
             key = self._plan_key("segmentsB", slotted, pkeys, pshapes)
             fn = self._cache.get(key)
             if fn is None:
-                def per_shard(params_, *arrays):
-                    frags = dict(zip(pkeys, arrays))
+                # Loop-local values (layout, per_shard, len(flat)) are
+                # FROZEN into the closures as keyword defaults, here and
+                # in every executable builder below: jax re-traces a
+                # cached executable when a later call changes the stacked
+                # group size, and a re-trace reads the closure CELLS —
+                # which a later loop iteration has rebound to the next
+                # group's values.  A compressed group re-traced with
+                # another group's layout decodes with the wrong
+                # container buckets (e.g. r_bucket=0 silently drops
+                # every run container).
+                def per_shard(params_, *arrays, _layout=layout):
+                    frags = _unpack_frags(_layout, arrays)
                     return jax.vmap(
                         lambda p: eval_plan(slotted, frags, p))(
                             params_)                   # [B, W]
 
                 vmapped = jax.vmap(per_shard,
-                                   in_axes=(None,) + (0,) * len(pshapes))
+                                   in_axes=(None,) + (0,) * len(flat))
                 if self.multiprocess:
-                    def block_fn(params_, *arrays):
-                        segs = vmapped(params_, *arrays)  # [S_local, B, W]
+                    def block_fn(params_, *arrays, _vm=vmapped):
+                        segs = _vm(params_, *arrays)   # [S_local, B, W]
                         return jax.lax.all_gather(segs, SHARD_AXIS,
                                                   tiled=True)
 
                     fn = self._jit_shard_map(
                         key, block_fn,
-                        (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes),
+                        (P(),) + tuple(P(SHARD_AXIS) for _ in flat),
                         P(), check_vma=False)
                 else:
-                    def block_fn(params_, *arrays):
-                        return vmapped(params_, *arrays)  # [S_local, B, W]
+                    def block_fn(params_, *arrays, _vm=vmapped):
+                        return _vm(params_, *arrays)   # [S_local, B, W]
 
                     fn = self._jit_shard_map(
                         key, block_fn,
-                        (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes),
+                        (P(),) + tuple(P(SHARD_AXIS) for _ in flat),
                         P(SHARD_AXIS))
             with _DISPATCH_LOCK:
-                segs = fn(params, *[a for _, a, _ in present])
+                segs = fn(params, *flat)
             host = np.asarray(jax.device_get(segs))    # [S, B, W]
             for i, shard in enumerate(shard_list):
                 out[shard] = host[i]
@@ -679,37 +840,41 @@ class MeshExecutor:
             if sig[0] is None:
                 continue  # field fragment absent everywhere in this group
             present = self._present(keys, placed, sig)
-            placed_args = [a for _, a, _ in present]
             pkeys = tuple(k for k, _, _ in present)
             pshapes = tuple(s for _, _, s in present)
+            flat, layout = _flatten_present(present)
             key = self._plan_key("row_counts", slotted, pkeys, pshapes)
             fn = self._cache.get(key)
             if fn is None:
                 fplan = slotted
 
-                def per_shard(params_, *arrays):
-                    frag = arrays[0]               # [rows, W]
+                # loop-local captures frozen as defaults (re-trace safety;
+                # see segments_batch)
+                def per_shard(params_, *arrays, _layout=layout,
+                              _k0=pkeys[0]):
+                    frags = _unpack_frags(_layout, arrays)
+                    frag = frags[_k0]              # [rows, W]
                     if fplan is None:
                         masked = frag
                     else:
-                        frags = dict(zip(pkeys, arrays))
                         seg = eval_plan(fplan, frags, params_)   # [W]
                         masked = frag & seg[None, :]
                     return jnp.sum(
                         jax.lax.population_count(masked).astype(jnp.int32),
                         axis=-1)                   # [rows]
 
-                def block_fn(params_, *arrays):
+                def block_fn(params_, *arrays, _ps=per_shard,
+                             _n=len(flat)):
                     counts = jnp.sum(jax.vmap(
-                        per_shard, in_axes=(None,) + (0,) * len(pshapes))(
+                        _ps, in_axes=(None,) + (0,) * _n)(
                             params_, *arrays), axis=0)
                     return jax.lax.psum(counts, axis_name=SHARD_AXIS)
 
                 fn = self._jit_shard_map(
                     key, block_fn,
-                    (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P())
             with _DISPATCH_LOCK:
-                parts.append(fn(params, *placed_args))
+                parts.append(fn(params, *flat))
         return parts
 
     def row_counts(self, field: str, view: str, filter_plan, holder,
@@ -731,36 +896,38 @@ class MeshExecutor:
         parts = []
         for shard_list, placed, sig in self._stream_groups(
                 keys, holder, index, shards):
-            if sig[0] is None or sig[0][0] < bsi.OFFSET_ROW + 1:
+            if sig[0] is None or _sig_rows(sig[0]) < bsi.OFFSET_ROW + 1:
                 continue
             present = self._present(keys, placed, sig)
-            placed_args = [a for _, a, _ in present]
             pkeys = tuple(k for k, _, _ in present)
             pshapes = tuple(s for _, _, s in present)
+            flat, layout = _flatten_present(present)
             key = self._plan_key("bsi_sum", slotted, pkeys, pshapes)
             fn = self._cache.get(key)
             if fn is None:
                 fplan = slotted
 
-                def per_shard(params_, *arrays):
-                    frag = arrays[0]
+                def per_shard(params_, *arrays, _layout=layout,
+                              _k0=pkeys[0]):
+                    frags = _unpack_frags(_layout, arrays)
+                    frag = frags[_k0]
                     filt = None
                     if fplan is not None:
-                        frags = dict(zip(pkeys, arrays))
                         filt = eval_plan(fplan, frags, params_)
                     return bsi.sum_counts(frag, filt)   # [2, depth+1]
 
-                def block_fn(params_, *arrays):
+                def block_fn(params_, *arrays, _ps=per_shard,
+                             _n=len(flat)):
                     counts = jnp.sum(jax.vmap(
-                        per_shard, in_axes=(None,) + (0,) * len(pshapes))(
+                        _ps, in_axes=(None,) + (0,) * _n)(
                             params_, *arrays), axis=0)
                     return jax.lax.psum(counts, axis_name=SHARD_AXIS)
 
                 fn = self._jit_shard_map(
                     key, block_fn,
-                    (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P())
             with _DISPATCH_LOCK:
-                parts.append(fn(params, *placed_args))
+                parts.append(fn(params, *flat))
         return parts
 
     def bsi_sum(self, field: str, view: str, filter_plan, holder,
@@ -785,31 +952,32 @@ class MeshExecutor:
         out = []
         for shard_list, placed, sig in self._stream_groups(
                 keys, holder, index, shards):
-            if sig[0] is None or sig[0][0] < bsi.OFFSET_ROW + 1:
+            if sig[0] is None or _sig_rows(sig[0]) < bsi.OFFSET_ROW + 1:
                 continue
             present = self._present(keys, placed, sig)
-            placed_args = [a for _, a, _ in present]
             pkeys = tuple(k for k, _, _ in present)
             pshapes = tuple(s for _, _, s in present)
+            flat, layout = _flatten_present(present)
             key = self._plan_key("bsi_minmax", slotted, pkeys, pshapes,
                                  extra=(want_max,))
             fn = self._cache.get(key)
             if fn is None:
                 fplan = slotted
 
-                def per_shard(params_, *arrays):
-                    frag = arrays[0]
+                def per_shard(params_, *arrays, _layout=layout,
+                              _k0=pkeys[0]):
+                    frags = _unpack_frags(_layout, arrays)
+                    frag = frags[_k0]
                     filt = None
                     if fplan is not None:
-                        frags = dict(zip(pkeys, arrays))
                         filt = eval_plan(fplan, frags, params_)
                     return bsi.min_max_bits(frag, filt, want_max=want_max)
 
                 if self.multiprocess:
-                    def block_fn(params_, *arrays):
+                    def block_fn(params_, *arrays, _ps=per_shard,
+                                 _n=len(flat)):
                         outs = jax.vmap(
-                            per_shard,
-                            in_axes=(None,) + (0,) * len(pshapes))(
+                            _ps, in_axes=(None,) + (0,) * _n)(
                                 params_, *arrays)
                         return tuple(
                             jax.lax.all_gather(o, SHARD_AXIS, tiled=True)
@@ -818,10 +986,10 @@ class MeshExecutor:
                     out_specs = (P(), P(), P())
                     check_vma = False
                 else:
-                    def block_fn(params_, *arrays):
+                    def block_fn(params_, *arrays, _ps=per_shard,
+                                 _n=len(flat)):
                         return jax.vmap(
-                            per_shard,
-                            in_axes=(None,) + (0,) * len(pshapes))(
+                            _ps, in_axes=(None,) + (0,) * _n)(
                                 params_, *arrays)
 
                     out_specs = (P(SHARD_AXIS), P(SHARD_AXIS),
@@ -830,10 +998,10 @@ class MeshExecutor:
 
                 fn = self._jit_shard_map(
                     key, block_fn,
-                    (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes),
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat),
                     out_specs, check_vma=check_vma)
             with _DISPATCH_LOCK:
-                outs = fn(params, *placed_args)
+                outs = fn(params, *flat)
             bits, neg, cnt = (np.asarray(x) for x in outs)
             for i in range(len(shard_list)):
                 out.append(bsi.reconstruct_min_max(
@@ -864,28 +1032,30 @@ class MeshExecutor:
             present = self._present(keys, placed, sig)
             pkeys = tuple(k for k, _, _ in present)
             pshapes = tuple(s for _, _, s in present)
+            flat, layout = _flatten_present(present)
             key = self._plan_key("countB", slotted, pkeys, pshapes)
             fn = self._cache.get(key)
             if fn is None:
-                def per_shard(params_, *arrays):
-                    frags = dict(zip(pkeys, arrays))
+                def per_shard(params_, *arrays, _layout=layout):
+                    frags = _unpack_frags(_layout, arrays)
                     segs = jax.vmap(
                         lambda p: eval_plan(slotted, frags, p))(params_)
                     return jnp.sum(
                         jax.lax.population_count(segs).astype(jnp.int32),
                         axis=-1)                       # [B]
 
-                def block_fn(params_, *arrays):
+                def block_fn(params_, *arrays, _ps=per_shard,
+                             _n=len(flat)):
                     counts = jnp.sum(jax.vmap(
-                        per_shard, in_axes=(None,) + (0,) * len(pshapes))(
+                        _ps, in_axes=(None,) + (0,) * _n)(
                             params_, *arrays), axis=0)
                     return jax.lax.psum(counts, axis_name=SHARD_AXIS)
 
                 fn = self._jit_shard_map(
                     key, block_fn,
-                    (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P())
             with _DISPATCH_LOCK:
-                parts.append(fn(params, *[a for _, a, _ in present]))
+                parts.append(fn(params, *flat))
         return parts
 
     def row_counts_batch_async(self, field: str, view: str, slotted_filter,
@@ -906,21 +1076,23 @@ class MeshExecutor:
             present = self._present(keys, placed, sig)
             pkeys = tuple(k for k, _, _ in present)
             pshapes = tuple(s for _, _, s in present)
+            flat, layout = _flatten_present(present)
             key = self._plan_key("row_countsB", slotted_filter, pkeys,
                                  pshapes)
             fn = self._cache.get(key)
             if fn is None:
                 fplan = slotted_filter
 
-                def per_shard(params_, *arrays):
-                    frag = arrays[0]                   # [rows, W]
+                def per_shard(params_, *arrays, _layout=layout,
+                              _k0=pkeys[0]):
+                    frags = _unpack_frags(_layout, arrays)
+                    frag = frags[_k0]                  # [rows, W]
                     if fplan is None:
                         counts = jnp.sum(
                             jax.lax.population_count(frag).astype(jnp.int32),
                             axis=-1)                   # [rows]
                         return jnp.broadcast_to(
                             counts, (params_.shape[0],) + counts.shape)
-                    frags = dict(zip(pkeys, arrays))
                     masks = jax.vmap(
                         lambda p: eval_plan(fplan, frags, p))(params_)
                     masked = frag[None, :, :] & masks[:, None, :]
@@ -928,17 +1100,18 @@ class MeshExecutor:
                         jax.lax.population_count(masked).astype(jnp.int32),
                         axis=-1)                       # [B, rows]
 
-                def block_fn(params_, *arrays):
+                def block_fn(params_, *arrays, _ps=per_shard,
+                             _n=len(flat)):
                     counts = jnp.sum(jax.vmap(
-                        per_shard, in_axes=(None,) + (0,) * len(pshapes))(
+                        _ps, in_axes=(None,) + (0,) * _n)(
                             params_, *arrays), axis=0)
                     return jax.lax.psum(counts, axis_name=SHARD_AXIS)
 
                 fn = self._jit_shard_map(
                     key, block_fn,
-                    (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P())
             with _DISPATCH_LOCK:
-                parts.append(fn(params, *[a for _, a, _ in present]))
+                parts.append(fn(params, *flat))
         return parts
 
     def bsi_sum_batch_async(self, field: str, view: str, slotted_filter,
@@ -953,23 +1126,25 @@ class MeshExecutor:
         # holder per (group x chunk)
         for shard_list, placed, sig in self._placed_groups(
                 keys, holder, index, shards):
-            if sig[0] is None or sig[0][0] < bsi.OFFSET_ROW + 1:
+            if sig[0] is None or _sig_rows(sig[0]) < bsi.OFFSET_ROW + 1:
                 continue
             present = self._present(keys, placed, sig)
             pkeys = tuple(k for k, _, _ in present)
             pshapes = tuple(s for _, _, s in present)
+            flat, layout = _flatten_present(present)
             key = self._plan_key("bsi_sumB", slotted_filter, pkeys, pshapes)
             fn = self._cache.get(key)
             if fn is None:
                 fplan = slotted_filter
 
-                def per_shard(params_, *arrays):
-                    frag = arrays[0]
+                def per_shard(params_, *arrays, _layout=layout,
+                              _k0=pkeys[0]):
+                    frags = _unpack_frags(_layout, arrays)
+                    frag = frags[_k0]
                     if fplan is None:
                         counts = bsi.sum_counts(frag, None)
                         return jnp.broadcast_to(
                             counts, (params_.shape[0],) + counts.shape)
-                    frags = dict(zip(pkeys, arrays))
 
                     def one(p):
                         return bsi.sum_counts(frag, eval_plan(fplan, frags,
@@ -977,17 +1152,18 @@ class MeshExecutor:
 
                     return jax.vmap(one)(params_)      # [B, 2, depth+1]
 
-                def block_fn(params_, *arrays):
+                def block_fn(params_, *arrays, _ps=per_shard,
+                             _n=len(flat)):
                     counts = jnp.sum(jax.vmap(
-                        per_shard, in_axes=(None,) + (0,) * len(pshapes))(
+                        _ps, in_axes=(None,) + (0,) * _n)(
                             params_, *arrays), axis=0)
                     return jax.lax.psum(counts, axis_name=SHARD_AXIS)
 
                 fn = self._jit_shard_map(
                     key, block_fn,
-                    (P(),) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P())
             with _DISPATCH_LOCK:
-                parts.append(fn(params, *[a for _, a, _ in present]))
+                parts.append(fn(params, *flat))
         return parts
 
     # -- GroupBy inner loop (executor.go:1068 executeGroupBy) --------------
@@ -1047,9 +1223,9 @@ class MeshExecutor:
             if any(key_to_sig[k] is None for k in prefix_keys):
                 continue
             present = self._present(keys, placed, sig)
-            placed_args = [a for _, a, _ in present]
             pkeys = tuple(k for k, _, _ in present)
             pshapes = tuple(s for _, _, s in present)
+            flat, layout = _flatten_present(present)
             key = self._plan_key("group_countsB", slotted, pkeys, pshapes,
                                  extra=(tuple(prefix_keys), pad_c))
             fn = self._cache.get(key)
@@ -1082,25 +1258,27 @@ class MeshExecutor:
                         jax.lax.population_count(masked).astype(jnp.int32),
                         axis=-1)                       # [rows]
 
-                def per_shard(rids_, params_, *arrays):
-                    frags = dict(zip(pkeys, arrays))
-                    frag = arrays[0]                   # [rows, W]
+                def per_shard(rids_, params_, *arrays, _layout=layout,
+                              _k0=pkeys[0], _oc=one_combo):
+                    frags = _unpack_frags(_layout, arrays)
+                    frag = frags[_k0]                  # [rows, W]
                     return jax.vmap(
-                        lambda r: one_combo(r, params_, frags, frag))(
+                        lambda r: _oc(r, params_, frags, frag))(
                             rids_)                     # [C, rows]
 
-                def block_fn(rids_, params_, *arrays):
+                def block_fn(rids_, params_, *arrays, _ps=per_shard,
+                             _n=len(flat)):
                     counts = jnp.sum(jax.vmap(
-                        per_shard,
-                        in_axes=(None, None) + (0,) * len(pshapes))(
+                        _ps,
+                        in_axes=(None, None) + (0,) * _n)(
                             rids_, params_, *arrays), axis=0)
                     return jax.lax.psum(counts, axis_name=SHARD_AXIS)
 
                 fn = self._jit_shard_map(
                     key, block_fn,
-                    (P(), P()) + tuple(P(SHARD_AXIS) for _ in pshapes), P())
+                    (P(), P()) + tuple(P(SHARD_AXIS) for _ in flat), P())
             with _DISPATCH_LOCK:
-                parts.append(fn(rids, params, *placed_args))
+                parts.append(fn(rids, params, *flat))
         return parts
 
 

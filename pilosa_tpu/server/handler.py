@@ -316,13 +316,18 @@ def build_router(api: API, server=None) -> Router:
         # durability & recovery (docs/robustness.md): quarantine state,
         # torn-tail/repair event counters, anti-entropy health
         from ..storage.fragment import storage_events
+        container_stats = api.holder.container_stats()
         out["storage"] = {
             "events": storage_events(),
             "quarantined": api.holder.quarantined_fragments(),
             "corruptAttrStores": api.holder.corrupt_attr_stores(),
+            # compressed residency (docs/memory-budget.md): per-holder
+            # container-type histogram + device-form census; the
+            # compressed/dense byte split rides deviceBudget above
+            "containers": container_stats,
         }
         if server is not None:
-            server.update_storage_gauges()
+            server.update_storage_gauges(container_stats=container_stats)
             if getattr(server, "cluster", None) is not None:
                 out["storage"]["antiEntropy"] = server.cluster.ae_snapshot()
         return out

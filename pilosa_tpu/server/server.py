@@ -53,6 +53,18 @@ class Config:
     # eviction skip the sparse->dense expansion.  0 disables the cache,
     # -1 = unbounded.
     host_stage_mb: int = 4096
+    # -- compressed residency (docs/memory-budget.md) ----------------------
+    # Keep sparse fragments HBM-resident as packed array/bitmap/run
+    # container streams (ops/containers.py), decoded to dense tiles on
+    # device at op time; engages only under a device-budget limit.
+    compressed_resident: bool = True
+    # Density fallback: a fragment compresses only when its estimated
+    # packed bytes are at most this fraction of its dense footprint
+    # (dense corpora stay dense — no decode cost, no ~1x "compression").
+    compress_max_density: float = 0.5
+    # Per-launch dense decode workspace ceiling (MB): shard slices are
+    # cut so one launch never decodes more dense tile bytes than this.
+    decode_workspace_mb: int = 1024
     # monitors / metrics (reference server/config.go metric section)
     anti_entropy_interval: float = 600.0
     metric_poll_interval: float = 60.0
@@ -174,6 +186,12 @@ class Config:
                 "dispatch_batch_window_us", float),
             "PILOSA_TPU_DEVICE_BUDGET_MB": ("device_budget_mb", int),
             "PILOSA_TPU_HOST_STAGE_MB": ("host_stage_mb", int),
+            "PILOSA_TPU_COMPRESSED_RESIDENT": (
+                "compressed_resident", lambda s: s != "false"),
+            "PILOSA_TPU_COMPRESS_MAX_DENSITY": ("compress_max_density",
+                                                float),
+            "PILOSA_TPU_DECODE_WORKSPACE_MB": ("decode_workspace_mb",
+                                               int),
             "PILOSA_TPU_METRIC_SERVICE": ("metric_service", str),
             "PILOSA_TPU_METRIC_HOST": ("metric_host", str),
             "PILOSA_TPU_DIAGNOSTICS_ENDPOINT": ("diagnostics_endpoint",
@@ -235,6 +253,9 @@ class Config:
             "dispatch-batch-window-us": "dispatch_batch_window_us",
             "device-budget-mb": "device_budget_mb",
             "host-stage-mb": "host_stage_mb",
+            "compressed-resident": "compressed_resident",
+            "compress-max-density": "compress_max_density",
+            "decode-workspace-mb": "decode_workspace_mb",
             "max-body-mb": "max_body_mb",
             "max-body-internal-mb": "max_body_internal_mb",
             "query-timeout": "query_timeout",
@@ -302,6 +323,15 @@ class Server:
         _fragment.WAL_CRC = bool(self.config.wal_crc)
         _fragment.QUARANTINE_ON_CORRUPTION = bool(
             self.config.quarantine_on_corruption)
+        # compressed residency (docs/memory-budget.md): process-wide
+        # module knobs on the fragment codec and the mesh slice planner,
+        # same most-recent-Server-wins convention as the budgets
+        _fragment.COMPRESSED_RESIDENT = bool(self.config.compressed_resident)
+        _fragment.COMPRESS_MAX_DENSITY = max(
+            float(self.config.compress_max_density), 0.0)
+        from ..parallel import mesh_exec as _mesh_exec
+        _mesh_exec.DECODE_WORKSPACE_BYTES = \
+            max(self.config.decode_workspace_mb, 1) << 20
         data_dir = os.path.expanduser(self.config.data_dir)
         self.holder = Holder(
             data_dir, max_op_n=self.config.max_op_n,
@@ -513,11 +543,13 @@ class Server:
             except Exception as e:
                 self.logger.error(f"quarantine repair failed: {e}")
 
-    def update_storage_gauges(self):
+    def update_storage_gauges(self, container_stats=None):
         """Durability counters -> stats gauges (referenced from the
         fragment codec's module docs): called on the metric poll AND from
         the /metrics and /debug/vars handlers so scrapes see current
-        values, not poll-stale ones."""
+        values, not poll-stale ones.  ``container_stats`` lets a caller
+        that already computed Holder.container_stats() (the /debug/vars
+        handler) pass it in instead of re-walking every fragment."""
         from ..storage.fragment import storage_events
         ev = storage_events()
         self.stats.gauge("storage.quarantine_events", ev["quarantine"])
@@ -526,6 +558,20 @@ class Server:
         self.stats.gauge("storage.repairs", ev["repair"])
         self.stats.gauge("storage.quarantined_fragments",
                          len(self.holder.quarantined_fragments()))
+        # compressed residency (docs/memory-budget.md): resident split +
+        # container-type histogram of the packed streams
+        from ..storage.membudget import DEFAULT_BUDGET
+        b = DEFAULT_BUDGET.stats()
+        self.stats.gauge("runtime.hbm_compressed_bytes",
+                         b["compressedBytes"])
+        self.stats.gauge("runtime.hbm_dense_bytes", b["denseBytes"])
+        cs = container_stats if container_stats is not None \
+            else self.holder.container_stats()
+        self.stats.gauge("storage.containers_array", cs["array"])
+        self.stats.gauge("storage.containers_bitmap", cs["bitmap"])
+        self.stats.gauge("storage.containers_run", cs["run"])
+        self.stats.gauge("storage.compressed_fragments",
+                         cs["compressedFragments"])
 
     def drain(self, timeout: float | None = None) -> bool:
         """Graceful drain: stop ADMITTING public queries (new ones get

@@ -11,21 +11,23 @@ in megabytes, where a dense ``[rows, 32768]`` tensor per fragment would
 need terabytes), replacing roaring's array/run containers as the sparsity
 mechanism (roaring/roaring.go:64-69).
 
-The device mirror is materialised DENSE (``uint32[cap_rows, SHARD_WORDS]``)
-on first query and stays resident in HBM — dense tiles are what the TPU
-bit-kernels operate on (see core.py).  Mirrors register with a
-DeviceBudget: under a configured limit the least-recently-used mirrors are
-evicted and transparently re-uploaded on next use (the HBM analog of the
-reference's mmap paging + syswrap map caps, syswrap/mmap.go:46).
-
-Container-tile block-sparsity on the DEVICE (uploading only non-empty
-2048-word tiles plus a key table) was considered and deferred: with
-uniformly sparse data every tile is non-empty (a 0.1%-density row still
-touches every container), the roaring array-container win only appears
-under heavy clustering, and tile gather/scatter puts a data-dependent
-indirection on the hot path that XLA cannot fuse.  The budget + eviction
-path bounds worst-case HBM instead; revisit if profiles show clustered
-tiles dominating.
+The device mirror takes one of two forms, chosen per fragment by a
+density heuristic (``device_form``).  Dense fragments materialise the
+full ``uint32[cap_rows, SHARD_WORDS]`` tensor — dense tiles are what the
+TPU bit-kernels operate on (see core.py).  Sparse fragments (under a
+configured device budget) stay HBM-resident in COMPRESSED form instead: a
+packed array/bitmap/run container stream (ops/containers.py, the
+word-granularity analog of roaring/roaring.go:64-69) that the mesh
+executor decodes to dense tiles ON DEVICE at op time, inside the query's
+own XLA program.  Residency then costs compressed bytes — ~8 bytes per
+non-zero word, a few words per run — so over-budget dense working sets
+become resident compressed ones (docs/memory-budget.md).  The heuristic
+falls back to dense where density warrants (``compress-max-density``), so
+dense corpora never pay decode cost or the ~1x "compression" of
+all-bitmap streams.  Mirrors and packed streams register with a
+DeviceBudget: under a configured limit the least-recently-used entries
+are evicted and transparently re-staged on next use (the HBM analog of
+the reference's mmap paging + syswrap map caps, syswrap/mmap.go:46).
 
 Mutations update the sparse store immediately and append to a write-ahead
 op log; snapshots rewrite the on-disk file and truncate the WAL after
@@ -91,6 +93,18 @@ _WAL_MAX_FRAME = 1 << 30
 # raising out of open().
 WAL_CRC = True
 QUARANTINE_ON_CORRUPTION = True
+
+# Compressed-resident device mirrors (docs/memory-budget.md "Compressed
+# residency"): under a configured device budget, fragments whose packed
+# container stream is small enough stay HBM-resident compressed and are
+# decoded to dense tiles on device at op time.  COMPRESSED_RESIDENT
+# disables the path wholesale; COMPRESS_MAX_DENSITY is the fallback
+# knob — a fragment compresses only when its estimated packed bytes are
+# at most this fraction of its dense footprint (dense corpora pack into
+# all-bitmap streams at ~1.01x dense and must stay on the dense path).
+# Process-wide, set from the server config like WAL_CRC above.
+COMPRESSED_RESIDENT = True
+COMPRESS_MAX_DENSITY = 0.5
 
 # Storage-event counters (surfaced at /debug/vars and /metrics via
 # Server.update_storage_gauges): process-wide, like the knobs above.
@@ -206,6 +220,13 @@ class Fragment:
         # host-side dense staging cache: (gen, dense block) — see
         # staged_dense()
         self._stage = None
+        # packed container stream cache: (gen, ops.containers.Packed) —
+        # see packed_host(); _comp_est is the (gen, bytes) estimate the
+        # density heuristic uses without packing, and _psig the (gen,
+        # sig tuple) bucket signature so stack tokens never repack
+        self._packed = None
+        self._comp_est = None
+        self._psig = None
         self._device_dirty = True
         self._op_n = 0
         self._dirty_data = False  # mutated since last snapshot?
@@ -1051,7 +1072,109 @@ class Fragment:
 
     def _drop_stage(self):
         HOST_STAGE_BUDGET.unregister(("stage", id(self)))
+        HOST_STAGE_BUDGET.unregister(("packed", id(self)))
         self._stage = None
+        self._packed = None
+
+    # -- compressed-resident form (ops/containers.py) ----------------------
+
+    def packed_host(self):
+        """This fragment's packed container stream (array/bitmap/run
+        containers over the sparse word store), built host-side WITHOUT
+        materialising the dense tensor and cached by data generation —
+        snapshot load + packing never allocates cap_rows x 128KB.  The
+        cache registers with HOST_STAGE_BUDGET like the dense stage (a
+        re-stage accelerator, evictable under host pressure; limit 0
+        disables caching and the pack stays transient)."""
+        from ..ops import containers
+        with self._lock:
+            p = self._packed
+            if p is not None and p[0] == self.gen:
+                HOST_STAGE_BUDGET.touch(("packed", id(self)))
+                return p[1]
+            packed = containers.pack_words(self._idx, self._val)
+            # exact packed bytes supersede the census upper bound as the
+            # density-heuristic input, for free
+            self._comp_est = (self.gen, packed.nbytes)
+            if HOST_STAGE_BUDGET.limit_bytes != 0:
+                self._packed = (self.gen, packed)
+                HOST_STAGE_BUDGET.register(("packed", id(self)),
+                                           packed.nbytes,
+                                           self._evict_packed)
+            return packed
+
+    def _evict_packed(self):
+        # host-stage budget callback: drop the cached pack only
+        self._packed = None
+
+    def _compressed_est(self) -> int:
+        """Gen-cached upper bound on the packed stream's bytes (cheap:
+        container census over the sparse indices, no packing)."""
+        from ..ops import containers
+        with self._lock:
+            e = self._comp_est
+            if e is not None and e[0] == self.gen:
+                return e[1]
+            est = containers.estimate_packed_bytes(self._idx)
+            self._comp_est = (self.gen, est)
+            return est
+
+    def device_form(self) -> str:
+        """'compressed' | 'dense': which device-resident form this
+        fragment's data warrants.  Compressed only under a configured
+        device budget (with unlimited HBM the dense mirror is strictly
+        faster — no decode per launch — exactly as staged_dense only
+        caches under a limit) and only when the density heuristic says
+        the packed stream actually undercuts the dense footprint."""
+        from ..ops.containers import MAX_COMPRESSED_ROWS
+        if not COMPRESSED_RESIDENT or self.budget.limit_bytes is None:
+            return "dense"
+        dense = self._cap_rows * SHARD_WORDS * 4
+        if dense == 0 or self._cap_rows > MAX_COMPRESSED_ROWS:
+            return "dense"
+        return "compressed" \
+            if self._compressed_est() <= COMPRESS_MAX_DENSITY * dense \
+            else "dense"
+
+    def device_nbytes(self) -> int:
+        """Bytes this fragment's device-resident form occupies — the
+        residency unit the budget and the shard-slice planner account
+        (compressed bytes for compressed-form fragments, the dense
+        tensor for the rest)."""
+        if self.device_form() == "compressed":
+            return self.packed_host().nbytes
+        return self._cap_rows * SHARD_WORDS * 4
+
+    def device_sig(self) -> tuple:
+        """Stacked-group shape signature for the mesh executor: dense
+        fragments keep the (rows, words) tensor shape; compressed ones
+        carry ('z', rows, C, P, A, R) with pow2-bucketed container,
+        payload, array-entry and run counts so one compiled decode
+        executable serves every fragment in a bucket."""
+        if self.device_form() == "dense":
+            return (self.n_rows, SHARD_WORDS)
+        from ..ops.containers import pow2_bucket
+        with self._lock:
+            s = self._psig
+            if s is not None and s[0] == self.gen:
+                return s[1]
+        p = self.packed_host()
+        sig = ("z", self.n_rows, pow2_bucket(p.keys.size),
+               pow2_bucket(p.payload.size), pow2_bucket(p.a_max),
+               pow2_bucket(p.r_max))
+        with self._lock:
+            self._psig = (self.gen, sig)
+        return sig
+
+    def packed_stats(self) -> dict | None:
+        """Container-type histogram of the CURRENT packed stream, or
+        None when no current pack exists (never packs on demand — this
+        feeds metric scrapes, which must stay O(1) per fragment)."""
+        with self._lock:
+            p = self._packed
+            if p is None or p[0] != self.gen:
+                return None
+            return p[1].type_histogram()
 
     def device(self, target=None):
         """The HBM-resident mirror (uploads if stale).  This is the query
@@ -1079,7 +1202,22 @@ class Fragment:
             mirror = self._mirrors.get(target)
             key = (id(self), target)
             if mirror is None:
-                mirror = jax.device_put(self.staged_dense(), target)
+                if self.device_form() == "compressed":
+                    # compressed upload: ship the packed container
+                    # stream (compressed bytes on the wire) and decode
+                    # to the dense mirror ON DEVICE — the host-side
+                    # sparse->dense expansion and the dense transfer
+                    # both disappear.  The mirror itself is dense (this
+                    # per-shard path indexes rows directly), so it
+                    # registers at dense bytes like any other mirror;
+                    # compressed RESIDENCY lives on the mesh path
+                    # (parallel/mesh_exec.py), which keeps the packed
+                    # stream itself as the resident form.
+                    from ..ops.containers import upload_decode
+                    mirror = upload_decode(self.packed_host(),
+                                           self._cap_rows, target)
+                else:
+                    mirror = jax.device_put(self.staged_dense(), target)
                 self._mirrors[target] = mirror
                 self.budget.register(
                     key, self._cap_rows * SHARD_WORDS * 4,

@@ -134,6 +134,26 @@ class Holder:
                             "shard": shard, "reason": frag.quarantined})
         return out
 
+    def container_stats(self, index: str | None = None) -> dict:
+        """Aggregate container-type histogram of the fragments currently
+        holding a packed (compressed-resident) stream, plus how many
+        fragments are in each device form (docs/memory-budget.md
+        "Compressed residency").  Never packs on demand — fragments
+        without a current pack count as dense-form or uncounted, keeping
+        metric scrapes O(fragments) with O(1) work each."""
+        out = {"array": 0, "bitmap": 0, "run": 0,
+               "compressedFragments": 0, "denseFragments": 0}
+        for *_ignored, frag in self.iter_fragments(index):
+            st = frag.packed_stats()
+            if st is not None and frag.device_form() == "compressed":
+                out["array"] += st["array"]
+                out["bitmap"] += st["bitmap"]
+                out["run"] += st["run"]
+                out["compressedFragments"] += 1
+            else:
+                out["denseFragments"] += 1
+        return out
+
     def corrupt_attr_stores(self, index: str | None = None) -> list[dict]:
         """Attr stores whose JSON was corrupt at open (bad bytes moved
         aside to ``.corrupt``, store restarted empty; attr anti-entropy

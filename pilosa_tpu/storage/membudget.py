@@ -34,9 +34,10 @@ from typing import Callable
 class DeviceBudget:
     def __init__(self, limit_bytes: int | None = None):
         self.limit_bytes = limit_bytes  # None = unlimited (accounting only)
-        # key -> [nbytes, evict callback, pin count]
+        # key -> [nbytes, evict callback, pin count, compressed bytes]
         self._entries: OrderedDict[tuple, list] = OrderedDict()
         self._total = 0
+        self._compressed = 0  # portion of _total held in packed form
         self._peak = 0
         self.evictions = 0
         # streaming pipeline counters (parallel/mesh_exec.py): bytes
@@ -73,8 +74,9 @@ class DeviceBudget:
                     break
             if victim is None:
                 break  # all pinned: admit over-limit
-            freed, cb, _ = self._entries.pop(victim)
+            freed, cb, _, comp = self._entries.pop(victim)
             self._total -= freed
+            self._compressed -= comp
             self.evictions += 1
             to_evict.append(cb)
         return to_evict
@@ -87,21 +89,27 @@ class DeviceBudget:
             except Exception:
                 pass
 
-    def register(self, key: tuple, nbytes: int, evict: Callable[[], None]):
+    def register(self, key: tuple, nbytes: int, evict: Callable[[], None],
+                 compressed_bytes: int = 0):
         """Account ``nbytes`` under ``key``; ``evict`` drops the owner's
         reference when called.  Evicts LRU entries first if needed (never
         evicting the incoming entry itself).  Re-registering an existing
         key keeps its pin count (the owner re-staged data an in-flight
-        user still holds pinned)."""
+        user still holds pinned).  ``compressed_bytes`` is the portion of
+        ``nbytes`` held as packed container streams rather than dense
+        tensors (docs/memory-budget.md "Compressed residency") — it
+        splits the resident gauge, not the accounting."""
         with self._lock:
             old = self._entries.pop(key, None)
             pins = 0
             if old is not None:
                 self._total -= old[0]
+                self._compressed -= old[3]
                 pins = old[2]
             to_evict = self._evict_lru_locked(nbytes)
-            self._entries[key] = [nbytes, evict, pins]
+            self._entries[key] = [nbytes, evict, pins, compressed_bytes]
             self._total += nbytes
+            self._compressed += compressed_bytes
             self._peak = max(self._peak, self._total)
             self.upload_bytes += nbytes
         self._run_evictions(to_evict)
@@ -160,6 +168,7 @@ class DeviceBudget:
             e = self._entries.pop(key, None)
             if e is not None:
                 self._total -= e[0]
+                self._compressed -= e[3]
 
     def stats(self) -> dict:
         with self._lock:
@@ -167,6 +176,8 @@ class DeviceBudget:
                                if e[2] > 0)
             return {
                 "residentBytes": self._total,
+                "compressedBytes": self._compressed,
+                "denseBytes": self._total - self._compressed,
                 "peakBytes": self._peak,
                 "limitBytes": self.limit_bytes,
                 "entries": len(self._entries),

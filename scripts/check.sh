@@ -108,9 +108,12 @@ PYEOF
 # recovery"): the byte-level corruption fuzz (truncate/flip at every
 # offset of snapshot+WAL -> recover-or-quarantine, never a crash) and
 # the short deterministic 2-cycle kill -9 crash harness.  The 20-cycle
-# randomized soak is pytest -m slow.
+# randomized soak is pytest -m slow.  Compressed-residency codec
+# round-trip + compressed-vs-dense differential (docs/memory-budget.md
+# "Compressed residency") ride along: a decode bug corrupts query
+# results silently, so the differential is hygiene, not a nicety.
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' -p no:cacheprovider \
-    tests/test_durability.py tests/test_crash.py
+    tests/test_durability.py tests/test_crash.py tests/test_containers.py
 
 # committed bytecode/cache artifacts must never land in the tree
 bad=$(git ls-files | grep -E "__pycache__|\.pyc$" || true)

@@ -13,6 +13,7 @@ from pilosa_tpu.core import SHARD_WIDTH
 from pilosa_tpu.executor import Executor
 from pilosa_tpu.executor.executor import _batch_chunks
 from pilosa_tpu.storage import FieldOptions, Holder
+from pilosa_tpu.storage import fragment
 from pilosa_tpu.storage.fragment import Fragment
 from pilosa_tpu.storage.membudget import (
     DEFAULT_BUDGET, HOST_STAGE_BUDGET, DeviceBudget,
@@ -148,7 +149,11 @@ def wide(rng):
     return h
 
 
-def test_shard_schedule_slices_and_orders_by_residency(wide):
+def test_shard_schedule_slices_and_orders_by_residency(wide, monkeypatch):
+    # this test exercises the DENSE slicing machinery; compressed
+    # residency would shrink the working set under the budget and
+    # (correctly) stop carving slices — pin the dense form
+    monkeypatch.setattr(fragment, "COMPRESSED_RESIDENT", False)
     ex = Executor(wide, use_mesh=True)
     me = ex.mesh_exec
     shards = list(range(16))
