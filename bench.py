@@ -72,6 +72,48 @@ def _rand_rows(rng, n_rows, k):
     return rng.permuted(np.tile(np.arange(n_rows), (k, 1)), axis=1)[:, :8]
 
 
+def _device_telemetry() -> dict:
+    """Cumulative device-runtime counters (pilosa_tpu/utils/devobs.py):
+    legs bracket their work with these so every BENCH_*.json row carries
+    compile/retrace counts and the padding-waste ratio — the trajectory
+    can then distinguish "got slower" from "started recompiling".
+    Opening a bracket also RESTARTS the decode-workspace high-watermark,
+    so each leg's "device" row reports its own peak, not a
+    predecessor's."""
+    from pilosa_tpu.utils import devobs
+    c = devobs.COMPILES
+    led = devobs.LEDGER
+    out = {"compiles": c.compiles_total, "retraces": c.retraces_total,
+           "compile_s": c.compile_seconds_total,
+           "launches": led.launches_total,
+           "rows": led.rows_actual_total,
+           "padded": led.rows_padded_total,
+           "decode_bytes": led.decode_bytes_total}
+    led.reset_decode_peak()
+    return out
+
+
+def _device_delta(before: dict) -> dict:
+    from pilosa_tpu.utils import devobs
+    # read the leg-local peak BEFORE _device_telemetry restarts it
+    peak = devobs.LEDGER.decode_peak_bytes
+    after = _device_telemetry()
+    rows = after["rows"] - before["rows"]
+    padded = after["padded"] - before["padded"]
+    total = rows + padded
+    return {"compiles": after["compiles"] - before["compiles"],
+            "retraces": after["retraces"] - before["retraces"],
+            "compile_s": round(after["compile_s"] - before["compile_s"],
+                               3),
+            "launches": after["launches"] - before["launches"],
+            "padding_waste_ratio": round(padded / total, 4) if total
+            else 0.0,
+            "decode_mb": round(
+                (after["decode_bytes"] - before["decode_bytes"]) / 2**20,
+                2),
+            "decode_peak_mb": round(peak / 2**20, 2)}
+
+
 def build_indexes():
     from pilosa_tpu.core import SHARD_WIDTH
     from pilosa_tpu.storage import FieldOptions, Holder
@@ -1005,7 +1047,10 @@ def run_observability_smoke(rng, baseline_qps=None) -> dict:
         data_dir=tempfile.mkdtemp(prefix="ptpu_smko_"),
         bind="localhost:0", anti_entropy_interval=0,
         dispatch_batch_window_us=1000,
-        slow_query_threshold=0.5, trace_sample_rate=1.0))
+        slow_query_threshold=0.5, trace_sample_rate=1.0,
+        # fast time-series cadence so the leg can assert a full window
+        # of samples in seconds instead of minutes
+        timeseries_interval=0.05, timeseries_window=1.0))
     try:
         srv.open()
 
@@ -1060,6 +1105,38 @@ def run_observability_smoke(rng, baseline_qps=None) -> dict:
         text = get("/metrics").decode()
         assert "pilosa_tpu_http_query_seconds_bucket" in text, \
             "/metrics lacks the http.query latency histogram"
+        # device runtime (docs/observability.md "Device runtime"): after
+        # the load above the time-series ring must hold >= its window of
+        # samples (wrapped at least once), and the compile registry must
+        # have seen the leg's executables compile
+        deadline = time.perf_counter() + 10
+        while True:
+            ts = json.loads(get("/debug/timeseries"))
+            if (ts["coveredS"] >= ts["windowS"]
+                    and ts["samplesTotal"] > ts["capacity"]) \
+                    or time.perf_counter() >= deadline:
+                break
+            time.sleep(0.05)
+        assert ts["coveredS"] >= ts["windowS"], \
+            (f"time-series ring covers {ts['coveredS']}s of its "
+             f"{ts['windowS']}s window after the load")
+        assert ts["samplesTotal"] > ts["capacity"], \
+            "time-series ring never wrapped"
+        out["timeseries_samples"] = len(ts["samples"])
+        dev = json.loads(get("/debug/vars"))["device"]
+        assert dev["compiles"]["compiles"] > 0, \
+            "compile registry saw no executable compile"
+        assert "pilosa_tpu_device_compiles_total" in text and \
+            "pilosa_tpu_device_padding_waste_ratio" in text and \
+            "pilosa_tpu_device_decode_workspace_peak_bytes" in text, \
+            "/metrics lacks the device-runtime families"
+        out["device"] = {
+            "compiles": dev["compiles"]["compiles"],
+            "retraces": dev["compiles"]["retraces"],
+            "compile_s": dev["compiles"]["compileSecondsTotal"],
+            "padding_waste_ratio":
+                dev["launches"]["paddingWasteRatio"],
+        }
     finally:
         srv.close()
     return out
@@ -1242,9 +1319,11 @@ def run_compressed_smoke(rng) -> dict:
         DEFAULT_BUDGET.limit_bytes = budget
         DEFAULT_BUDGET.shrink_to_limit()
         DEFAULT_BUDGET.reset_peak()
+        dev0 = _device_telemetry()
         t0 = time.perf_counter()
         got = [_smoke_norm(ex.execute("ssb1b", b)) for b in batches]
         compressed_s = time.perf_counter() - t0
+        dev = _device_delta(dev0)
         assert got == want, \
             "compressed-resident results diverged from the dense run"
         stats = DEFAULT_BUDGET.stats()
@@ -1256,6 +1335,15 @@ def run_compressed_smoke(rng) -> dict:
         assert compressed_mb < dense_resident_mb, \
             (f"compressed footprint {compressed_mb:.1f}MB not below the "
              f"dense resident {dense_resident_mb}MB")
+        # device-runtime telemetry (docs/observability.md "Device
+        # runtime"): compressed launches must have decoded dense tiles
+        # (the workspace high-watermark is the knob's feedback loop) and
+        # the mixed-signature groups must have paid measurable bucket
+        # padding — both exported at /metrics, asserted non-zero here
+        assert dev["decode_mb"] > 0 and dev["decode_peak_mb"] > 0, \
+            "compressed leg decoded nothing: workspace telemetry dead"
+        assert dev["padding_waste_ratio"] > 0, \
+            "compressed leg padded nothing: padding telemetry dead"
         return {
             "budget_held": True,
             "compressed_mb": round(compressed_mb, 2),
@@ -1263,6 +1351,7 @@ def run_compressed_smoke(rng) -> dict:
             "effective_capacity_ratio": round(
                 n_shards * 12 * 32768 * 4 / stats["compressedBytes"], 1),
             "compressed_s": round(compressed_s, 2),
+            "device": dev,
         }
     finally:
         _frag.COMPRESSED_RESIDENT = old_form
@@ -1354,11 +1443,16 @@ def main():
     executor = Executor(holder, use_mesh=True)
     rng = np.random.default_rng(SEED + 1)
 
+    d0 = _device_telemetry()
     q1, l1, p1, b1, s1 = bench_config1(executor, meta, rng)
+    dev1, d0 = _device_delta(d0), _device_telemetry()
     q2, l2, p2, b2, s2 = bench_config2(executor, meta, rng)
+    dev2, d0 = _device_delta(d0), _device_telemetry()
     q3, l3, p3, b3, s3 = bench_config3(executor, meta, rng)
+    dev3, d0 = _device_delta(d0), _device_telemetry()
     q4, l4, p4, b4, gb_s, gb_grid_s, s4 = bench_config4(executor, meta,
                                                         rng)
+    dev4 = _device_delta(d0)
 
     (c1,), _ = best_of(lambda: (cpu_config1(holder, meta, rng),))
     (c2,), _ = best_of(lambda: (cpu_config2(holder, meta, rng),))
@@ -1382,14 +1476,19 @@ def main():
             f"config5 mismatch: {got5[0]} != {want5}"
         # resident variant: all 4 subset stacks fit (954 shards x 12 rows
         # x 128KB  stacked ~1.6GB; 6GB leaves staging headroom)
+        d5 = _device_telemetry()
         cfg5r = bench_config5(ex5, oracle_words, rng, 6144, resident=True)
+        cfg5r["device"], d5 = _device_delta(d5), _device_telemetry()
         cfg5 = bench_config5(ex5, oracle_words, rng, 768, resident=False)
+        cfg5["device"] = _device_delta(d5)
     finally:
         ex5.close()
     # compressed-residency leg (docs/memory-budget.md): the over-budget
     # cliff on the sparse corpus, compressed vs dense vs resident anchor
     try:
+        d5c = _device_telemetry()
         cfg5c = bench_config5_compressed(np.random.default_rng(SEED + 7))
+        cfg5c["device"] = _device_delta(d5c)
     except Exception as e:
         import traceback
         print(f"config 5 compressed leg failed: {e!r}", file=sys.stderr)
@@ -1438,20 +1537,23 @@ def main():
             "batch_p50_ms": round(p1 * 1e3, 1),
             "spread": s1, "vs_cpu": round(q1 / c1, 2),
             "cpu_qps": round(c1, 1),
-            "gbps": round(q1 * b1 / 1e9, 1)},
+            "gbps": round(q1 * b1 / 1e9, 1),
+            "device": dev1},
         "2_intersect8_1M_cols": {
             "qps": round(q2, 1), "batch_ms": round(l2 * 1e3, 1),
             "batch_p50_ms": round(p2 * 1e3, 1),
             "spread": s2, "vs_cpu": round(q2 / c2, 2),
             "cpu_qps": round(c2, 1),
-            "gbps": round(q2 * b2 / 1e9, 1)},
+            "gbps": round(q2 * b2 / 1e9, 1),
+            "device": dev2},
         "3_topn_filtered_10M_cols": {
             "qps": round(q3, 1), "batch_ms": round(l3 * 1e3, 1),
             "batch_p50_ms": round(p3 * 1e3, 1),
             "spread": s3, "vs_cpu": round(q3 / c3, 2),
             "cpu_qps": round(c3, 2),
             "gbps": round(q3 * b3 / 1e9, 1),
-            "hbm_frac": round(q3 * b3 / 1e9 / HBM_PEAK_GBS, 3)},
+            "hbm_frac": round(q3 * b3 / 1e9 / HBM_PEAK_GBS, 3),
+            "device": dev3},
         "4_bsi_sum_gt_64shards": {
             "qps": round(q4, 1), "batch_ms": round(l4 * 1e3, 1),
             "batch_p50_ms": round(p4 * 1e3, 1),
@@ -1460,7 +1562,8 @@ def main():
             "gbps": round(q4 * b4 / 1e9, 1),
             "hbm_frac": round(q4 * b4 / 1e9 / HBM_PEAK_GBS, 3),
             "groupby_s": round(gb_s, 3),
-            "groupby_128x128_s": round(gb_grid_s, 3)},
+            "groupby_128x128_s": round(gb_grid_s, 3),
+            "device": dev4},
         "5_topn_1B_cols_resident": cfg5r,
         "5_topn_1B_cols_budgeted": cfg5,
     }

@@ -232,6 +232,65 @@ def cmd_inspect(args) -> int:
     return 0
 
 
+def cmd_top(args) -> int:
+    """Live terminal summary of one node: poll /debug/timeseries +
+    /debug/vars and render qps, p99, the HBM split, evictions/s, and
+    compile/retrace counts — the operator loop for a box with no
+    Prometheus attached (docs/observability.md "Device runtime")."""
+    import time as _time
+
+    base = _base_url(args.host)
+    mb = 1 << 20
+    polls = 0
+    prev_retraces = None
+    try:
+        while True:
+            v = _http("GET", f"{base}/debug/vars")
+            ts = _http("GET", f"{base}/debug/timeseries")
+            samples = ts.get("samples") or []
+            last = samples[-1] if samples else {}
+            dt = ts.get("intervalS") or 1.0
+            qps = last.get("httpQueriesDelta", 0) / dt
+            evs = last.get("evictionsDelta", 0) / dt
+            p99 = (v.get("timings", {}).get("http.query") or {}).get("p99")
+            p99s = f"{p99 * 1e3:.1f}" if p99 is not None else "-"
+            bud = v.get("deviceBudget", {})
+            dev = v.get("device", {})
+            comp = dev.get("compiles", {})
+            lau = dev.get("launches", {})
+            adm = (v.get("admission") or {}).get("public", {})
+            bat = v.get("dispatchBatcher") or {}
+            retr = comp.get("retraces", 0)
+            flag = ""
+            if prev_retraces is not None and retr > prev_retraces:
+                # the PR-7-class red flag, front and center
+                flag = f"  !! +{retr - prev_retraces} RETRACE"
+            prev_retraces = retr
+            print(f"-- pilosa-tpu top @ {args.host}  "
+                  f"up {last.get('uptimeS', '-')}s  "
+                  f"({len(samples)} samples x {dt}s)")
+            print(f"   qps {qps:.1f}  p99 {p99s}ms  "
+                  f"inflight {adm.get('inUse', 0)}  "
+                  f"waiting {adm.get('waiting', 0)}  "
+                  f"batcher queued {bat.get('queued', 0)}")
+            print(f"   hbm {bud.get('residentBytes', 0) // mb}MB resident"
+                  f" ({bud.get('compressedBytes', 0) // mb}MB compressed"
+                  f" / {bud.get('denseBytes', 0) // mb}MB dense"
+                  f" / {bud.get('pinnedBytes', 0) // mb}MB pinned)  "
+                  f"evictions/s {evs:.2f}")
+            print(f"   device: compiles {comp.get('compiles', 0)}  "
+                  f"retraces {retr}{flag}  "
+                  f"launches {lau.get('launches', 0)}  "
+                  f"padding {100 * lau.get('paddingWasteRatio', 0):.1f}%  "
+                  f"decode peak {lau.get('decodePeakBytes', 0) // mb}MB")
+            polls += 1
+            if args.count and polls >= args.count:
+                return 0
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 DEFAULT_CONFIG = """\
 # pilosa-tpu configuration
 data-dir = "~/.pilosa_tpu"
@@ -269,6 +328,10 @@ max-op-n = 10000
 # profile-default = false  # profile tree on every response, not just
 #                          # ?profile=true
 # trace-sample-rate = 1.0  # fraction of traces recorded (cluster-wide)
+# timeseries-interval = 5  # seconds between /debug/timeseries samples,
+#                          # 0 = sampler off
+# timeseries-window = 600  # seconds of history the time-series ring keeps
+# launch-ledger-size = 256 # /debug/launches ring entries
 
 [cluster]
 # hosts = ["localhost:10101", "localhost:10102"]
@@ -321,6 +384,9 @@ def cmd_config(args) -> int:
     print(f"slow-log-size = {cfg.slow_log_size}")
     print(f"profile-default = {str(cfg.profile_default).lower()}")
     print(f"trace-sample-rate = {cfg.trace_sample_rate}")
+    print(f"timeseries-interval = {cfg.timeseries_interval}")
+    print(f"timeseries-window = {cfg.timeseries_window}")
+    print(f"launch-ledger-size = {cfg.launch_ledger_size}")
     print()
     print("[cluster]")
     print(f"hosts = [{', '.join(q(h) for h in cfg.cluster_hosts)}]")
@@ -386,6 +452,14 @@ def main(argv=None) -> int:
     sp = sub.add_parser("inspect", help="inspect fragment file stats")
     sp.add_argument("files", nargs="+")
     sp.set_defaults(fn=cmd_inspect)
+
+    sp = sub.add_parser("top", help="live terminal summary of a node")
+    sp.add_argument("-host", default="localhost:10101")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between polls")
+    sp.add_argument("--count", type=int, default=0,
+                    help="polls before exiting (0 = forever)")
+    sp.set_defaults(fn=cmd_top)
 
     sp = sub.add_parser("generate-config", help="print default config")
     sp.set_defaults(fn=cmd_generate_config)

@@ -316,8 +316,15 @@ def pad_packed(p: Packed) -> tuple[np.ndarray, ...]:
 def _decode_jit(rows: int, words: int, a_bucket: int, r_bucket: int):
     import jax
 
+    def _traced(*a, **k):
+        # runs only while jax traces — the compile registry's exact
+        # per-bucket compile detector (docs/observability.md)
+        from ..utils import devobs
+        devobs.COMPILES.mark_traced()
+        return decode_block(*a, **k)
+
     return jax.jit(functools.partial(
-        decode_block, rows=rows, words=words, a_bucket=a_bucket,
+        _traced, rows=rows, words=words, a_bucket=a_bucket,
         r_bucket=r_bucket))
 
 
@@ -326,10 +333,31 @@ def upload_decode(p: Packed, rows: int, target=None,
     """Ship a packed stream to the device and decode it there to the
     dense mirror — Fragment.device()'s compressed upload path.  The
     transfer moves compressed bytes; the sparse->dense expansion happens
-    on device instead of in host memory + on the wire."""
+    on device instead of in host memory + on the wire.  Each (rows,
+    buckets) decode bucket reports its compiles to the device compile
+    registry like the mesh executables do."""
+    import time as _time
+
     import jax
 
+    from ..utils import devobs
+
     arrs = [jax.device_put(a, target) for a in pad_packed(p)]
-    fn = _decode_jit(rows, words, pow2_bucket(p.a_max),
-                     pow2_bucket(p.r_max))
-    return fn(*arrs)
+    a_b, r_b = pow2_bucket(p.a_max), pow2_bucket(p.r_max)
+    fn = _decode_jit(rows, words, a_b, r_b)
+    reg = devobs.COMPILES
+    reg.begin_call()
+    t0 = _time.perf_counter()
+    out = fn(*arrs)
+    if reg.traced():
+        # the container/payload pow2 buckets are intended shape
+        # polymorphism (one jit, one specialization per bucket), so they
+        # belong IN the signature — without them a second bucket of the
+        # same jit would read as a false retrace alarm
+        c_b = pow2_bucket(p.keys.size)
+        p_b = pow2_bucket(p.payload.size)
+        reg.note_call(
+            f"decode:{rows}x{words}:c{c_b}:p{p_b}:a{a_b}:r{r_b}",
+            "decode", _time.perf_counter() - t0,
+            devobs.fingerprint(arrs))
+    return out

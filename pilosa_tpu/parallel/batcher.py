@@ -50,6 +50,7 @@ from contextlib import contextmanager
 import numpy as np
 
 from ..executor.plan import parametrize, plan_inputs
+from ..utils import devobs
 from ..utils import profile as qprof
 from ..utils.deadline import DeadlineExceeded, activate, current
 from ..utils.faults import FAULTS
@@ -414,16 +415,24 @@ class DispatchBatcher:
                 # the ticket's QueryContext rides into the direct path so
                 # shard-slice deadline checks + failpoints behave exactly
                 # as an un-batched call would; trace + profile context
-                # re-attach so slice events/spans parent under the query
-                with activate(t.ctx), GLOBAL_TRACER.attach(t.trace), \
-                        qprof.activate(t.prof):
-                    t0 = time.perf_counter()
-                    result = self._direct(t)
-                    if t.prof is not None:
-                        t.prof.event("batcher.launch",
-                                     time.perf_counter() - t0,
-                                     node=t.prof_node, kind=t.kind,
-                                     fused=False)
+                # re-attach so slice events/spans parent under the query;
+                # the launch-ledger context carries the queued wait into
+                # the device launches this ticket drives
+                ltok = devobs.set_launch_ctx(
+                    queue_s=max(time.monotonic() - t.enq, 0.0),
+                    tickets=1, rows=t.params.shape[0])
+                try:
+                    with activate(t.ctx), GLOBAL_TRACER.attach(t.trace), \
+                            qprof.activate(t.prof):
+                        t0 = time.perf_counter()
+                        result = self._direct(t)
+                        if t.prof is not None:
+                            t.prof.event("batcher.launch",
+                                         time.perf_counter() - t0,
+                                         node=t.prof_node, kind=t.kind,
+                                         fused=False)
+                finally:
+                    devobs.reset_launch_ctx(ltok)
             except BaseException as e:
                 t.future.set_exception(
                     e if isinstance(e, Exception)
@@ -473,21 +482,31 @@ class DispatchBatcher:
         return [self.mesh.batch_keys((p["field"], p["view"]),
                                      p["slotted"])]
 
-    def _note_fused(self, tickets, dur_s):
-        """Attribute one fused launch back to every participating query:
+    def _note_fused(self, tickets, dur_s, batch_rows=0, padded_rows=0):
+        """Attribute one fused launch back to EVERY participating query:
         a profile event under each ticket's captured node and a
         synthesized span under each sampled trace (there is no single
-        owner to nest a live span under)."""
+        owner to nest a live span under) — so warm profiles of batched
+        queries stop under-reporting device time.  Each ticket's event
+        carries the fused batch size, its own row share, and its share
+        of the pow-2 padding rows the launch computed for nobody."""
+        pad_share = round(padded_rows / len(tickets), 2) if padded_rows \
+            else 0
         for t in tickets:
             if t.prof is not None:
                 t.prof.event("batcher.launch", dur_s, node=t.prof_node,
                              kind=t.kind, fused=True,
-                             batchTickets=len(tickets))
+                             batchTickets=len(tickets),
+                             batchRows=batch_rows,
+                             ticketRows=t.params.shape[0],
+                             paddedRowsShare=pad_share)
             if t.trace is not None and t.trace.sampled:
                 GLOBAL_TRACER.record_span(
                     "dispatch.fused_launch", t.trace.trace_id,
                     t.trace.span_id, dur_s,
-                    {"kind": t.kind, "tickets": len(tickets)},
+                    {"kind": t.kind, "tickets": len(tickets),
+                     "batchRows": batch_rows,
+                     "paddedRows": padded_rows},
                     collect=t.trace.collect)
 
     def _launch_fused(self, kind, tickets):
@@ -517,26 +536,37 @@ class DispatchBatcher:
             # one failpoint/chaos gate per fused launch, matching the
             # per-slice gate of the direct path
             FAULTS.hit("mesh.slice", key=p0["index"])
-            if kind == "count":
-                parts = mesh.count_batch_async(
-                    p0["slotted"], mat, p0["holder"], p0["index"],
-                    p0["shards"])
-            elif kind == "row_counts":
-                parts = mesh.row_counts_batch_async(
-                    p0["field"], p0["view"], p0["slotted"], mat,
-                    p0["holder"], p0["index"], p0["shards"])
-            elif kind == "bsi_sum":
-                parts = mesh.bsi_sum_batch_async(
-                    p0["field"], p0["view"], p0["slotted"], mat,
-                    p0["holder"], p0["index"], p0["shards"])
-            else:  # segments
-                self._scatter_segments(tickets, mat, p0)
-                return
+            # launch ledger context: the queued wait and the ACTUAL fused
+            # row count ride into the device launch so padding waste is
+            # measured, not inferred (docs/observability.md)
+            queue_s = max(time.monotonic()
+                          - min(t.enq for t in tickets), 0.0)
+            ltok = devobs.set_launch_ctx(queue_s=queue_s,
+                                         tickets=len(tickets), rows=B)
+            try:
+                if kind == "count":
+                    parts = mesh.count_batch_async(
+                        p0["slotted"], mat, p0["holder"], p0["index"],
+                        p0["shards"])
+                elif kind == "row_counts":
+                    parts = mesh.row_counts_batch_async(
+                        p0["field"], p0["view"], p0["slotted"], mat,
+                        p0["holder"], p0["index"], p0["shards"])
+                elif kind == "bsi_sum":
+                    parts = mesh.bsi_sum_batch_async(
+                        p0["field"], p0["view"], p0["slotted"], mat,
+                        p0["holder"], p0["index"], p0["shards"])
+                else:  # segments
+                    self._scatter_segments(tickets, mat, p0, pad - B)
+                    return
+            finally:
+                devobs.reset_launch_ctx(ltok)
             # attribute the launch BEFORE resolving any future: once a
             # future resolves, its owner thread may serialize the profile
             # tree, and late appends would race that (profile.py's
             # owner-blocked invariant)
-            self._note_fused(tickets, time.perf_counter() - t_launch0)
+            self._note_fused(tickets, time.perf_counter() - t_launch0,
+                             batch_rows=B, padded_rows=pad - B)
             # scatter: per-ticket views into the fused device results.
             # Outputs are replicated (psum, P() specs), so slicing is a
             # local per-device gather — but hold the collective-launch
@@ -559,12 +589,14 @@ class DispatchBatcher:
         self.stats.count("dispatch.launch.fused")
         self.stats.count("dispatch.fused_queries", len(tickets))
 
-    def _scatter_segments(self, tickets, mat, p0):
+    def _scatter_segments(self, tickets, mat, p0, padded_rows=0):
         t_launch0 = time.perf_counter()
         by_shard = self.mesh.segments_batch(
             p0["slotted"], mat, p0["holder"], p0["index"], p0["shards"])
         # as in _launch_fused: attribute before any future resolves
-        self._note_fused(tickets, time.perf_counter() - t_launch0)
+        self._note_fused(tickets, time.perf_counter() - t_launch0,
+                         batch_rows=mat.shape[0] - padded_rows,
+                         padded_rows=padded_rows)
         lo = 0
         for t in tickets:  # segments tickets are always scalar (B=1)
             t.future.set_result(

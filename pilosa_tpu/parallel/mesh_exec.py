@@ -60,6 +60,7 @@ working set is resident.
 
 from __future__ import annotations
 
+import itertools as _itertools
 import time as _time
 from concurrent import futures
 
@@ -71,6 +72,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core import SHARD_WORDS
 from ..ops import bsi
 from ..executor.plan import eval_plan, parametrize, plan_inputs
+from ..utils import devobs as _devobs
 from ..utils import profile as qprof
 from ..utils.deadline import check_current
 from ..utils.faults import FAULTS
@@ -152,9 +154,84 @@ import threading as _threading
 _DISPATCH_LOCK = _threading.Lock()
 
 
+class _InstrumentedExec:
+    """One compiled shard_map executable plus its device-runtime
+    telemetry (utils/devobs.py, docs/observability.md "Device runtime").
+
+    The wrapped block_fn marks the compile registry whenever jax TRACES
+    it (the python body only runs while tracing), so every call knows
+    whether it compiled; a signature tracing more than once is the
+    retrace red flag the PR 7 bug never raised.  Every invocation also
+    lands in the launch ledger: padded sizes read off the args
+    themselves, the actual stacked shard count passed by the call site
+    as ``_launch_meta``, queue/ticket context installed by the dispatch
+    batcher, and the streaming slice position installed by
+    _ShardSchedule."""
+
+    __slots__ = ("fn", "sig", "kind", "detail", "n_fixed",
+                 "decode_per_shard")
+
+    def __init__(self, fn, key, layout):
+        self.fn = fn
+        self.kind = key[0] if key and isinstance(key[0], str) else "exec"
+        self.sig = _devobs.sig_of(key)
+        self.detail = repr(key[1])[:120] if len(key) > 1 else ""
+        # leading replicated (P()) args before the stacked fragment args
+        self.n_fixed = 2 if self.kind == "group_countsB" else 1
+        # transient dense tiles this executable decodes per stacked
+        # shard row (compressed layout entries expand inside the launch)
+        self.decode_per_shard = sum(
+            s[1] * SHARD_WORDS * 4 for _, n, s in layout if n > 1)
+
+    def __call__(self, *args, _launch_meta=None):
+        reg = _devobs.COMPILES
+        reg.begin_call()
+        t0 = _time.perf_counter()
+        out = self.fn(*args)
+        dt = _time.perf_counter() - t0
+        compiled = reg.traced()
+        if compiled:  # fingerprinting is only paid on compiles
+            reg.note_call(self.sig, self.kind, dt,
+                          _devobs.fingerprint(args), detail=self.detail)
+        # call-site meta: actual shard count, or (shards, actual batch
+        # rows) where the call site pads its own batch axis outside the
+        # batcher (group_countsB's pow-2 combo padding)
+        meta_rows = None
+        if isinstance(_launch_meta, tuple):
+            _launch_meta, meta_rows = _launch_meta
+        params = args[0] if self.kind == "group_countsB" \
+            else args[self.n_fixed - 1]
+        b_pad = params.shape[0] if getattr(params, "ndim", 0) == 2 else 1
+        stacked = args[self.n_fixed] if len(args) > self.n_fixed else None
+        shards_pad = stacked.shape[0] if stacked is not None else 0
+        shards = _launch_meta if _launch_meta is not None else shards_pad
+        ctx = _devobs.launch_ctx() or {}
+        rows = ctx.get("rows")
+        if rows is None:
+            rows = meta_rows
+        _devobs.LEDGER.record(
+            sig=self.sig, kind=self.kind, shards=shards,
+            shards_padded=shards_pad,
+            batch_rows=rows if rows is not None else b_pad,
+            batch_rows_padded=b_pad,
+            queue_s=ctx.get("queue_s", 0.0),
+            tickets=ctx.get("tickets", 1),
+            dispatch_s=dt, compiled=compiled,
+            decode_bytes=self.decode_per_shard * shards,
+            slice_pos=_devobs.current_slice())
+        prof = qprof.current()
+        if prof is not None:
+            prof.event("device.launch", dt, kind=self.kind, sig=self.sig,
+                       shards=shards, compiled=compiled)
+        return out
+
+
 def default_mesh(devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     return Mesh(np.array(devices), axis_names=(SHARD_AXIS,))
+
+
+_EXEC_SEQ = _itertools.count()
 
 
 class MeshExecutor:
@@ -162,6 +239,10 @@ class MeshExecutor:
 
     def __init__(self, mesh: Mesh | None = None):
         self.mesh = mesh or default_mesh()
+        # monotonic per-process instance number: disambiguates this
+        # executor's plan keys (and thus compile-registry signatures)
+        # from any earlier executor's — see _plan_key
+        self._exec_seq = next(_EXEC_SEQ)
         self.n_devices = self.mesh.devices.size
         # A mesh spanning >1 jax process (multihost mode 2,
         # parallel/multihost.py): shard-axis-sharded OUTPUTS are not
@@ -218,22 +299,37 @@ class MeshExecutor:
     # -- compiled executables ---------------------------------------------
 
     def _jit_shard_map(self, key, block_fn, in_specs, out_specs,
-                       check_vma: bool = True):
+                       check_vma: bool = True, layout=()):
         """``check_vma=False`` for multiprocess gather executables: their
         P() outputs ARE replicated (all_gather over the shard axis), but
-        shard_map's static varying-axes checker cannot infer that."""
+        shard_map's static varying-axes checker cannot infer that.
+        ``layout`` (from _flatten_present) sizes the launch ledger's
+        decode-workspace attribution; the cached object is the
+        executable wrapped in its telemetry hooks (_InstrumentedExec)."""
         fn = self._cache.get(key)
         if fn is None:
-            fn = jax.jit(_shard_map(
-                block_fn, mesh=self.mesh,
-                in_specs=in_specs, out_specs=out_specs,
-                **{_SM_CHECK_KW: check_vma}))
+            def traced_body(*a, _fn=block_fn):
+                # runs ONLY while jax traces: an exact compile detector
+                _devobs.COMPILES.mark_traced()
+                return _fn(*a)
+
+            fn = _InstrumentedExec(
+                jax.jit(_shard_map(
+                    traced_body, mesh=self.mesh,
+                    in_specs=in_specs, out_specs=out_specs,
+                    **{_SM_CHECK_KW: check_vma})),
+                key, layout)
             self._cache[key] = fn
         return fn
 
     def _plan_key(self, kind, plan, input_keys, shapes, extra=()):
+        # _exec_seq, not id(self.mesh): a GC'd mesh's id can be REUSED by
+        # the next one, and a byte-identical key would then make the
+        # process-global compile registry read a fresh executor's first
+        # compile as a PR-7-class retrace (a false alarm on the one
+        # signal that must stay trustworthy)
         return (kind, repr(plan), tuple(input_keys), tuple(shapes),
-                tuple(extra), id(self.mesh))
+                tuple(extra), self._exec_seq)
 
     def _compiled(self, slotted_plan, input_keys, shapes, layout, reducer):
         """``slotted_plan`` comes from ``parametrize``: the executable is
@@ -275,7 +371,7 @@ class MeshExecutor:
             in_specs = (P(),) + tuple(P(SHARD_AXIS)
                                       for _ in range(n_args))
             return self._jit_shard_map(key, block_fn, in_specs, P(),
-                                       check_vma=False)
+                                       check_vma=False, layout=layout)
         else:
             def block_fn(params, *arrays):
                 return vmapped(params, *arrays)    # [S_local, W]
@@ -283,7 +379,8 @@ class MeshExecutor:
             out_specs = P(SHARD_AXIS)
 
         in_specs = (P(),) + tuple(P(SHARD_AXIS) for _ in range(n_args))
-        return self._jit_shard_map(key, block_fn, in_specs, out_specs)
+        return self._jit_shard_map(key, block_fn, in_specs, out_specs,
+                                   layout=layout)
 
     # -- shard grouping ----------------------------------------------------
 
@@ -700,7 +797,8 @@ class MeshExecutor:
                                 tuple(s for _, _, s in present), layout,
                                 "count")
             with _DISPATCH_LOCK:
-                parts.append(fn(params, *flat))
+                parts.append(fn(params, *flat,
+                                _launch_meta=len(shard_list)))
         return parts
 
     def count(self, plan, holder, index, shards) -> int:
@@ -727,7 +825,7 @@ class MeshExecutor:
                                 tuple(s for _, _, s in present), layout,
                                 None)
             with _DISPATCH_LOCK:
-                segs = fn(params, *flat)
+                segs = fn(params, *flat, _launch_meta=len(shard_list))
             # ONE addressable-shard host assembly.  Indexing the sharded
             # output per row (`segs[i]`) launched a collective reshard
             # program per shard, and per-row collectives from concurrent
@@ -796,7 +894,7 @@ class MeshExecutor:
                     fn = self._jit_shard_map(
                         key, block_fn,
                         (P(),) + tuple(P(SHARD_AXIS) for _ in flat),
-                        P(), check_vma=False)
+                        P(), check_vma=False, layout=layout)
                 else:
                     def block_fn(params_, *arrays, _vm=vmapped):
                         return _vm(params_, *arrays)   # [S_local, B, W]
@@ -804,9 +902,9 @@ class MeshExecutor:
                     fn = self._jit_shard_map(
                         key, block_fn,
                         (P(),) + tuple(P(SHARD_AXIS) for _ in flat),
-                        P(SHARD_AXIS))
+                        P(SHARD_AXIS), layout=layout)
             with _DISPATCH_LOCK:
-                segs = fn(params, *flat)
+                segs = fn(params, *flat, _launch_meta=len(shard_list))
             host = np.asarray(jax.device_get(segs))    # [S, B, W]
             for i, shard in enumerate(shard_list):
                 out[shard] = host[i]
@@ -872,9 +970,11 @@ class MeshExecutor:
 
                 fn = self._jit_shard_map(
                     key, block_fn,
-                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P())
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P(),
+                    layout=layout)
             with _DISPATCH_LOCK:
-                parts.append(fn(params, *flat))
+                parts.append(fn(params, *flat,
+                                _launch_meta=len(shard_list)))
         return parts
 
     def row_counts(self, field: str, view: str, filter_plan, holder,
@@ -925,9 +1025,11 @@ class MeshExecutor:
 
                 fn = self._jit_shard_map(
                     key, block_fn,
-                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P())
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P(),
+                    layout=layout)
             with _DISPATCH_LOCK:
-                parts.append(fn(params, *flat))
+                parts.append(fn(params, *flat,
+                                _launch_meta=len(shard_list)))
         return parts
 
     def bsi_sum(self, field: str, view: str, filter_plan, holder,
@@ -999,9 +1101,9 @@ class MeshExecutor:
                 fn = self._jit_shard_map(
                     key, block_fn,
                     (P(),) + tuple(P(SHARD_AXIS) for _ in flat),
-                    out_specs, check_vma=check_vma)
+                    out_specs, check_vma=check_vma, layout=layout)
             with _DISPATCH_LOCK:
-                outs = fn(params, *flat)
+                outs = fn(params, *flat, _launch_meta=len(shard_list))
             bits, neg, cnt = (np.asarray(x) for x in outs)
             for i in range(len(shard_list)):
                 out.append(bsi.reconstruct_min_max(
@@ -1053,9 +1155,11 @@ class MeshExecutor:
 
                 fn = self._jit_shard_map(
                     key, block_fn,
-                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P())
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P(),
+                    layout=layout)
             with _DISPATCH_LOCK:
-                parts.append(fn(params, *flat))
+                parts.append(fn(params, *flat,
+                                _launch_meta=len(shard_list)))
         return parts
 
     def row_counts_batch_async(self, field: str, view: str, slotted_filter,
@@ -1109,9 +1213,11 @@ class MeshExecutor:
 
                 fn = self._jit_shard_map(
                     key, block_fn,
-                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P())
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P(),
+                    layout=layout)
             with _DISPATCH_LOCK:
-                parts.append(fn(params, *flat))
+                parts.append(fn(params, *flat,
+                                _launch_meta=len(shard_list)))
         return parts
 
     def bsi_sum_batch_async(self, field: str, view: str, slotted_filter,
@@ -1161,9 +1267,11 @@ class MeshExecutor:
 
                 fn = self._jit_shard_map(
                     key, block_fn,
-                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P())
+                    (P(),) + tuple(P(SHARD_AXIS) for _ in flat), P(),
+                    layout=layout)
             with _DISPATCH_LOCK:
-                parts.append(fn(params, *flat))
+                parts.append(fn(params, *flat,
+                                _launch_meta=len(shard_list)))
         return parts
 
     # -- GroupBy inner loop (executor.go:1068 executeGroupBy) --------------
@@ -1276,9 +1384,13 @@ class MeshExecutor:
 
                 fn = self._jit_shard_map(
                     key, block_fn,
-                    (P(), P()) + tuple(P(SHARD_AXIS) for _ in flat), P())
+                    (P(), P()) + tuple(P(SHARD_AXIS) for _ in flat), P(),
+                    layout=layout)
             with _DISPATCH_LOCK:
-                parts.append(fn(rids, params, *flat))
+                # (shards, C): the pow-2 combo padding (pad_c - C rows)
+                # must count as padding waste, not actual work
+                parts.append(fn(rids, params, *flat,
+                                _launch_meta=(len(shard_list), C)))
         return parts
 
 
@@ -1343,16 +1455,21 @@ class _ShardSchedule:
         prof = qprof.current()
         budget = self.mexec._budget
         if len(self.slices) <= 1:
-            for sl in self.slices:
-                FAULTS.hit("mesh.slice", key=self.index)
-                check_current("mesh shard slice")
-                if prof is None:
-                    yield sl
-                else:
-                    t0, up0, ev0 = (_time.perf_counter(),
-                                    budget.upload_bytes, budget.evictions)
-                    yield sl
-                    self._slice_event(prof, 0, sl, t0, up0, ev0)
+            try:
+                for sl in self.slices:
+                    FAULTS.hit("mesh.slice", key=self.index)
+                    check_current("mesh shard slice")
+                    _devobs.set_slice(0, 1)
+                    if prof is None:
+                        yield sl
+                    else:
+                        t0, up0, ev0 = (_time.perf_counter(),
+                                        budget.upload_bytes,
+                                        budget.evictions)
+                        yield sl
+                        self._slice_event(prof, 0, sl, t0, up0, ev0)
+            finally:
+                _devobs.set_slice(None)
             return
         pool = self.mexec._uploader_pool()
         fut = None   # in-flight prefetch of the slice about to be served
@@ -1392,6 +1509,9 @@ class _ShardSchedule:
                         GLOBAL_TRACER.task(self._stage,
                                            name="mesh.prefetch_slice"),
                         self.slices[i + 1])
+                # launch-ledger slice position: dispatches between this
+                # yield and the next run against slice i
+                _devobs.set_slice(i, len(self.slices))
                 yield sl
                 # the consumer dispatched against this slice between the
                 # yield and here — safe to let the budget rotate it out
@@ -1401,6 +1521,7 @@ class _ShardSchedule:
                     budget.unpin(k)
                 pins = []
         finally:
+            _devobs.set_slice(None)
             for k in pins:
                 budget.unpin(k)
             if fut is not None:

@@ -1,0 +1,142 @@
+"""/debug/dashboard: a zero-dependency single-file HTML view of the
+in-process time-series ring (docs/observability.md "Device runtime").
+
+The page polls /debug/timeseries (and /debug/vars for the header line)
+on the ring's own cadence and renders inline-SVG sparklines — no
+external scripts, fonts, or build step, so "what happened in the last
+10 minutes" is answerable from the node itself with nothing but a
+browser pointed at it.  All numbers come from the ring's samples; the
+page does no aggregation beyond per-sample ratios."""
+
+DASHBOARD_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>pilosa-tpu dashboard</title>
+<style>
+  :root { color-scheme: dark; }
+  body { margin: 0; padding: 16px 20px; background: #14161a;
+         color: #d6d9de; font: 13px/1.45 system-ui, sans-serif; }
+  h1 { font-size: 15px; margin: 0 0 2px; font-weight: 600; }
+  #meta { color: #8a8f98; margin-bottom: 14px; }
+  #grid { display: grid; gap: 12px;
+          grid-template-columns: repeat(auto-fill, minmax(330px, 1fr)); }
+  .card { background: #1b1e24; border: 1px solid #262a31;
+          border-radius: 6px; padding: 10px 12px 6px; }
+  .card h2 { font-size: 12px; margin: 0 0 4px; font-weight: 600;
+             color: #aab0b9; }
+  .card .now { float: right; color: #e8eaed; font-variant-numeric:
+               tabular-nums; }
+  svg { width: 100%; height: 64px; display: block; }
+  .axis { color: #6b7077; font-size: 10px; display: flex;
+          justify-content: space-between; }
+  .err { color: #e07a5f; }
+</style>
+</head>
+<body>
+<h1>pilosa-tpu &middot; device runtime</h1>
+<div id="meta">loading&hellip;</div>
+<div id="grid"></div>
+<script>
+"use strict";
+const COLORS = ["#7aa2f7", "#9ece6a", "#e0af68", "#f7768e", "#bb9af7"];
+const MB = b => b / 1048576;
+const CHARTS = [
+  {title: "qps", unit: "q/s",
+   series: [{label: "queries", f: (s, dt) => s.httpQueriesDelta / dt}]},
+  {title: "p99 latency", unit: "ms",
+   series: [{label: "http.query", f: s => s.httpQueryP99Ms}]},
+  {title: "HBM residency", unit: "MB",
+   series: [{label: "compressed", f: s => MB(s.hbmCompressedBytes)},
+            {label: "dense", f: s => MB(s.hbmDenseBytes)},
+            {label: "pinned", f: s => MB(s.hbmPinnedBytes)}]},
+  {title: "evictions / uploads", unit: "/s",
+   series: [{label: "evictions", f: (s, dt) => s.evictionsDelta / dt},
+            {label: "upload MB", f: (s, dt) =>
+                MB(s.uploadBytesDelta) / dt}]},
+  {title: "compiles &amp; retraces", unit: "/interval",
+   series: [{label: "compiles", f: s => s.compilesDelta},
+            {label: "retraces", f: s => s.retracesDelta}]},
+  {title: "queue depth", unit: "",
+   series: [{label: "admission", f: s => s.admissionInUse +
+                s.admissionWaiting},
+            {label: "batcher", f: s => s.batcherQueued}]},
+  {title: "launch padding waste", unit: "%",
+   series: [{label: "padded", f: s => {
+       const t = s.rowsActualDelta + s.rowsPaddedDelta;
+       return t ? 100 * s.rowsPaddedDelta / t : 0; }}]},
+  {title: "decode workspace peak", unit: "MB",
+   series: [{label: "peak", f: s => MB(s.decodePeakBytes)}]},
+];
+function fmt(v) {
+  if (!isFinite(v)) return "-";
+  if (Math.abs(v) >= 1000) return v.toFixed(0);
+  if (Math.abs(v) >= 10) return v.toFixed(1);
+  return v.toFixed(2);
+}
+function spark(rows) {
+  const w = 320, h = 60, n = rows[0].length;
+  let lo = Infinity, hi = -Infinity;
+  for (const r of rows) for (const v of r) {
+    if (isFinite(v)) { lo = Math.min(lo, v); hi = Math.max(hi, v); }
+  }
+  if (!isFinite(lo)) { lo = 0; hi = 1; }
+  if (hi - lo < 1e-9) { hi = lo + 1; }
+  const x = i => n < 2 ? w : i * w / (n - 1);
+  const y = v => h - 4 - (v - lo) * (h - 8) / (hi - lo);
+  let paths = "";
+  rows.forEach((r, k) => {
+    const pts = r.map((v, i) =>
+      `${x(i).toFixed(1)},${y(isFinite(v) ? v : lo).toFixed(1)}`);
+    paths += `<polyline fill="none" stroke="${COLORS[k % 5]}"
+      stroke-width="1.5" points="${pts.join(" ")}"/>`;
+  });
+  return {svg: `<svg viewBox="0 0 ${w} ${h}"
+    preserveAspectRatio="none">${paths}</svg>`, lo, hi};
+}
+function render(ts, vars) {
+  const s = ts.samples || [];
+  const dt = ts.intervalS || 1;
+  const last = s[s.length - 1] || {};
+  const counts = (vars && vars.counts) || {};
+  document.getElementById("meta").textContent =
+    `interval ${ts.intervalS}s · window ${ts.windowS}s · ` +
+    `${s.length}/${ts.capacity} samples (${ts.coveredS}s covered) · ` +
+    `queries served ${counts["query"] || 0} · ` +
+    `up ${Math.round(last.uptimeS || 0)}s`;
+  const grid = document.getElementById("grid");
+  grid.innerHTML = "";
+  for (const c of CHARTS) {
+    const rows = c.series.map(ser => s.map(p => ser.f(p, dt)));
+    const {svg, lo, hi} = spark(rows.length ? rows : [[0]]);
+    const now = rows.map((r, k) =>
+      `<span style="color:${COLORS[k % 5]}">${c.series[k].label} ` +
+      `${fmt(r[r.length - 1] ?? 0)}</span>`).join(" &middot; ");
+    const card = document.createElement("div");
+    card.className = "card";
+    card.innerHTML = `<h2>${c.title} <span class="now">${now}` +
+      ` ${c.unit}</span></h2>${svg}` +
+      `<div class="axis"><span>${fmt(lo)}</span>` +
+      `<span>${fmt(hi)} ${c.unit}</span></div>`;
+    grid.appendChild(card);
+  }
+}
+async function tick() {
+  try {
+    const [ts, vars] = await Promise.all([
+      fetch("/debug/timeseries").then(r => r.json()),
+      fetch("/debug/vars").then(r => r.json()).catch(() => null),
+    ]);
+    render(ts, vars);
+    setTimeout(tick, Math.max((ts.intervalS || 5) * 1000, 1000));
+  } catch (e) {
+    document.getElementById("meta").innerHTML =
+      `<span class="err">fetch failed: ${e}</span>`;
+    setTimeout(tick, 5000);
+  }
+}
+tick();
+</script>
+</body>
+</html>
+"""

@@ -330,17 +330,36 @@ def build_router(api: API, server=None) -> Router:
             server.update_storage_gauges(container_stats=container_stats)
             if getattr(server, "cluster", None) is not None:
                 out["storage"]["antiEntropy"] = server.cluster.ae_snapshot()
+        # device runtime (docs/observability.md "Device runtime"):
+        # compile-registry + launch-ledger aggregates and the
+        # time-series summary; full detail at /debug/compiles,
+        # /debug/launches, /debug/timeseries
+        from ..utils import devobs
+        out["device"] = {"compiles": devobs.COMPILES.totals(),
+                         "launches": devobs.LEDGER.aggregates()}
+        ts = getattr(server, "timeseries", None) if server is not None \
+            else None
+        if ts is not None:
+            snap_ts = ts.snapshot()
+            out["timeseries"] = {
+                k: snap_ts[k] for k in ("intervalS", "windowS",
+                                        "capacity", "samplesTotal",
+                                        "coveredS")}
         return out
 
     def metrics(req, args):
         if server is not None:
-            # refresh the storage.* gauges so scrapes see current values
+            # refresh the storage.* + device.* gauges so scrapes see
+            # current values
             server.update_storage_gauges()
         text = api.stats.prometheus_text()
-        # the batcher's histogram/summary series don't fit the stats
-        # client's counter/gauge model; it exports its own lines
+        # the batcher's and launch ledger's histogram/summary series
+        # don't fit the stats client's counter/gauge model; they export
+        # their own lines
         if api.executor.batcher is not None:
             text += api.executor.batcher.prometheus_text()
+        from ..utils import devobs
+        text += devobs.LEDGER.prometheus_text()
         return ("text/plain; version=0.0.4", text)
 
     if api.stats is not None:
@@ -365,6 +384,45 @@ def build_router(api: API, server=None) -> Router:
         return slog.snapshot()
 
     r.add("GET", "/debug/slow", debug_slow)
+
+    # -- device runtime (docs/observability.md "Device runtime") -----------
+
+    def debug_compiles(req, args):
+        """Compile registry: per-executable-signature compile counts,
+        trace+compile wall time, last argument-shape fingerprint — a
+        signature with compiles > 1 is a retrace (the PR-7-class silent
+        red flag this surface exists for)."""
+        from ..utils import devobs
+        return devobs.COMPILES.snapshot()
+
+    r.add("GET", "/debug/compiles", debug_compiles)
+
+    def debug_launches(req, args):
+        """Launch ledger: the ring of recent device launches (padding,
+        decode workspace, queue-vs-dispatch split, slice position) plus
+        its lifetime aggregates."""
+        from ..utils import devobs
+        return devobs.LEDGER.snapshot()
+
+    r.add("GET", "/debug/launches", debug_launches)
+
+    def debug_timeseries(req, args):
+        """In-process time-series ring (utils/timeseries.py): the last
+        timeseries-window seconds of runtime samples."""
+        ts = getattr(server, "timeseries", None) if server is not None \
+            else None
+        if ts is None:
+            return {"intervalS": 0, "windowS": 0, "capacity": 0,
+                    "samplesTotal": 0, "coveredS": 0, "samples": []}
+        return ts.snapshot()
+
+    r.add("GET", "/debug/timeseries", debug_timeseries)
+
+    def debug_dashboard(req, args):
+        from .dashboard import DASHBOARD_HTML
+        return ("text/html; charset=utf-8", DASHBOARD_HTML)
+
+    r.add("GET", "/debug/dashboard", debug_dashboard)
 
     # -- pprof-style profiling (handler.go:280 /debug/pprof) ---------------
 

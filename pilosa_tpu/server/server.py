@@ -156,6 +156,18 @@ class Config:
     # decision propagates to children and across the wire, so a trace is
     # recorded everywhere or nowhere.  1.0 = always-on (Dapper-style).
     trace_sample_rate: float = 1.0
+    # -- device-runtime observability (docs/observability.md) --------------
+    # Seconds between in-process time-series samples of the runtime
+    # gauges (HBM split, admission depth, compile/retrace counts, edge
+    # histogram deltas) served at /debug/timeseries and rendered by
+    # /debug/dashboard.  0 disables the sampler.
+    timeseries_interval: float = 5.0
+    # Seconds of history the time-series ring retains — the "what
+    # happened in the last N minutes" horizon; memory is one flat dict
+    # per window/interval samples.
+    timeseries_window: float = 600.0
+    # Entries kept in the device launch-ledger ring (/debug/launches).
+    launch_ledger_size: int = 256
     verbose: bool = False
 
     @classmethod
@@ -226,6 +238,10 @@ class Config:
             "PILOSA_TPU_PROFILE_DEFAULT": (
                 "profile_default", lambda s: s == "true"),
             "PILOSA_TPU_TRACE_SAMPLE_RATE": ("trace_sample_rate", float),
+            "PILOSA_TPU_TIMESERIES_INTERVAL": ("timeseries_interval",
+                                               float),
+            "PILOSA_TPU_TIMESERIES_WINDOW": ("timeseries_window", float),
+            "PILOSA_TPU_LAUNCH_LEDGER_SIZE": ("launch_ledger_size", int),
         }
         for env, (attr, conv) in env_map.items():
             if env in os.environ:
@@ -274,6 +290,9 @@ class Config:
             "slow-log-size": "slow_log_size",
             "profile-default": "profile_default",
             "trace-sample-rate": "trace_sample_rate",
+            "timeseries-interval": "timeseries_interval",
+            "timeseries-window": "timeseries_window",
+            "launch-ledger-size": "launch_ledger_size",
         }
         for key, attr in mapping.items():
             if key in doc:
@@ -406,6 +425,22 @@ class Server:
             threshold_s=self.config.slow_query_threshold,
             size=self.config.slow_log_size,
             logger=self.logger, stats=self.stats)
+        # Device-runtime observability (docs/observability.md "Device
+        # runtime"): the process-wide compile registry logs retraces
+        # through THIS server's logger (most recent Server wins, like
+        # the budgets), the launch ledger resizes to the configured
+        # ring, and the time-series ring samples the runtime gauges on
+        # its own monitor thread.
+        from ..utils import devobs
+        devobs.COMPILES.logger = self.logger
+        devobs.LEDGER.resize(self.config.launch_ledger_size)
+        from ..utils.timeseries import TimeSeriesRing
+        self.timeseries = None
+        self._ts_prev: dict = {}
+        if self.config.timeseries_interval > 0:
+            self.timeseries = TimeSeriesRing(
+                interval_s=self.config.timeseries_interval,
+                window_s=self.config.timeseries_window)
         self.httpd = make_http_server(
             self.api, host, port, server=self, tls=tls,
             max_body_bytes=self.config.max_body_mb << 20,
@@ -457,6 +492,11 @@ class Server:
             self._threads.append(t)
         if self.config.metric_poll_interval > 0:
             t = threading.Thread(target=self._monitor_runtime, daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self.timeseries is not None:
+            t = threading.Thread(target=self._monitor_timeseries,
+                                 daemon=True)
             t.start()
             self._threads.append(t)
         self.diagnostics.open()  # no-op unless an endpoint is configured
@@ -519,6 +559,76 @@ class Server:
             except Exception:
                 pass
 
+    def sample_timeseries(self, force: bool = False) -> bool:
+        """One time-series sample (docs/observability.md "Device
+        runtime"): level gauges (HBM split, host stage, admission and
+        batcher occupancy, decode high-watermark, instantaneous p99) plus
+        per-interval DELTAS of the monotone counters (edge histogram
+        count/sum, evictions, uploads, compiles/retraces, launches,
+        padding) so the ring answers "what changed in that interval"
+        directly.  The previous counter snapshot only advances when the
+        ring accepts the sample, so deltas always span exactly one
+        retained interval."""
+        if self.timeseries is None:
+            return False
+        from ..parallel import mesh_exec as _mesh_exec
+        from ..storage.membudget import DEFAULT_BUDGET, HOST_STAGE_BUDGET
+        from ..utils import devobs
+        b = DEFAULT_BUDGET.stats()
+        req_count, _ = self.stats.timing_totals("http.request")
+        q_count, q_sum = self.stats.timing_totals("http.query")
+        comp = devobs.COMPILES.totals()
+        led = devobs.LEDGER.aggregates()
+        adm = self.admission.snapshot()
+        counters = {
+            "httpRequests": req_count,
+            "httpQueries": q_count,
+            "httpQueryS": q_sum,
+            "evictions": b["evictions"],
+            "evictedBytes": b["evictedBytes"],
+            "uploadBytes": b["uploadBytes"],
+            "compiles": comp["compiles"],
+            "retraces": comp["retraces"],
+            "compileS": comp["compileSecondsTotal"],
+            "launches": led["launches"],
+            "rowsActual": led["rowsActual"],
+            "rowsPadded": led["rowsPadded"],
+        }
+        # The counter sources are process-wide singletons that predate
+        # this Server: the first sample has no previous snapshot, and
+        # reporting lifetime totals as "this interval's delta" would
+        # spike every dashboard sparkline — its deltas are zero instead.
+        prev = self._ts_prev or counters
+        values = {k + "Delta": round(v - prev.get(k, 0), 6)
+                  for k, v in counters.items()}
+        p99 = self.stats.percentile("http.query", 0.99)
+        batcher = self.api.executor.batcher
+        values.update({
+            "hbmResidentBytes": b["residentBytes"],
+            "hbmCompressedBytes": b["compressedBytes"],
+            "hbmDenseBytes": b["denseBytes"],
+            "hbmPinnedBytes": b["pinnedBytes"],
+            "hostStageBytes": HOST_STAGE_BUDGET.resident_bytes,
+            "admissionInUse": adm["inUse"],
+            "admissionWaiting": adm["waiting"],
+            "batcherQueued": batcher.pending() if batcher is not None
+            else 0,
+            "decodePeakBytes": led["decodePeakBytes"],
+            "decodeWorkspaceBytes": _mesh_exec.DECODE_WORKSPACE_BYTES,
+            "httpQueryP99Ms": round(p99 * 1e3, 3) if p99 else 0.0,
+        })
+        accepted = self.timeseries.sample(values, force=force)
+        if accepted:
+            self._ts_prev = counters
+        return accepted
+
+    def _monitor_timeseries(self):
+        while not self._closing.wait(self.config.timeseries_interval):
+            try:
+                self.sample_timeseries()
+            except Exception:
+                pass
+
     def _monitor_anti_entropy(self):
         """(server.go:514 monitorAntiEntropy)"""
         while not self._closing.wait(self.config.anti_entropy_interval):
@@ -572,6 +682,30 @@ class Server:
         self.stats.gauge("storage.containers_run", cs["run"])
         self.stats.gauge("storage.compressed_fragments",
                          cs["compressedFragments"])
+        self.update_device_gauges()
+
+    def update_device_gauges(self):
+        """Compile-registry + launch-ledger gauges (docs/observability.md
+        "Device runtime"), refreshed at scrape time like the storage
+        gauges so /metrics and /debug/vars see current values — a
+        retrace burst between metric polls must not be invisible."""
+        from ..parallel import mesh_exec as _mesh_exec
+        from ..utils import devobs
+        c = devobs.COMPILES.totals()
+        self.stats.gauge("device.compiles_total", c["compiles"])
+        self.stats.gauge("device.retraces_total", c["retraces"])
+        self.stats.gauge("device.compile_seconds_total",
+                         c["compileSecondsTotal"])
+        led = devobs.LEDGER.aggregates()
+        self.stats.gauge("device.launches_total", led["launches"])
+        self.stats.gauge("device.launch_rows", led["rowsActual"])
+        self.stats.gauge("device.padded_rows", led["rowsPadded"])
+        self.stats.gauge("device.padding_waste_ratio",
+                         led["paddingWasteRatio"])
+        self.stats.gauge("device.decode_workspace_peak_bytes",
+                         led["decodePeakBytes"])
+        self.stats.gauge("device.decode_workspace_limit_bytes",
+                         _mesh_exec.DECODE_WORKSPACE_BYTES)
 
     def drain(self, timeout: float | None = None) -> bool:
         """Graceful drain: stop ADMITTING public queries (new ones get

@@ -40,6 +40,7 @@ class DeviceBudget:
         self._compressed = 0  # portion of _total held in packed form
         self._peak = 0
         self.evictions = 0
+        self.evicted_bytes = 0  # an eviction storm's size, not just count
         # streaming pipeline counters (parallel/mesh_exec.py): bytes
         # (re-)registered = bytes shipped to the device, and whether a
         # scheduled slice's prefetch completed before the consumer
@@ -78,6 +79,7 @@ class DeviceBudget:
             self._total -= freed
             self._compressed -= comp
             self.evictions += 1
+            self.evicted_bytes += freed
             to_evict.append(cb)
         return to_evict
 
@@ -182,6 +184,7 @@ class DeviceBudget:
                 "limitBytes": self.limit_bytes,
                 "entries": len(self._entries),
                 "evictions": self.evictions,
+                "evictedBytes": self.evicted_bytes,
                 "uploadBytes": self.upload_bytes,
                 "prefetchHits": self.prefetch_hits,
                 "prefetchMisses": self.prefetch_misses,

@@ -125,6 +125,15 @@ class StatsClient:
             h = self._timings.get(self._key(name))
             return None if h is None else h.percentile(q)
 
+    def timing_totals(self, name: str) -> tuple[int, float]:
+        """(count, sum) of one timing series without building the full
+        snapshot — the time-series sampler reads these every interval,
+        and interpolating every series' percentiles per tick would be
+        pure waste."""
+        with self._lock:
+            h = self._timings.get(self._key(name))
+            return (0, 0.0) if h is None else (h.count, h.total)
+
     def set_value(self, name: str, value: str, rate: float = 1.0):
         with self._lock:
             key = self._key(name)

@@ -12,8 +12,10 @@ python -m compileall -q pilosa_tpu tests scripts bench.py
 # time.time() is allowed only at the annotated wall-clock sites:
 # diagnostics uptime reporting, the tracing span's display-only start
 # stamp (durations there come from a perf_counter pair), and the
-# anti-entropy last-error/last-success stamps (_wall_stamp — operator
-# display, never subtracted).
+# _wall_stamp helpers (anti-entropy last-error/last-success stamps, the
+# launch ledger + time-series sample stamps — operator display, never
+# subtracted; devobs/timeseries durations and interval pacing all come
+# from perf_counter).
 bad=$(grep -rn "time\.time()" pilosa_tpu bench.py \
     | grep -v "pilosa_tpu/utils/diagnostics.py" \
     | grep -v "self\.start = time\.time()" \
@@ -112,8 +114,12 @@ PYEOF
 # round-trip + compressed-vs-dense differential (docs/memory-budget.md
 # "Compressed residency") ride along: a decode bug corrupts query
 # results silently, so the differential is hygiene, not a nicety.
+# Device-runtime observability (docs/observability.md "Device runtime")
+# rides too: the retrace red flag is the alarm for that same decode-bug
+# class, so its test is hygiene as well.
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' -p no:cacheprovider \
-    tests/test_durability.py tests/test_crash.py tests/test_containers.py
+    tests/test_durability.py tests/test_crash.py tests/test_containers.py \
+    tests/test_device_obs.py
 
 # committed bytecode/cache artifacts must never land in the tree
 bad=$(git ls-files | grep -E "__pycache__|\.pyc$" || true)
