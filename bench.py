@@ -1142,6 +1142,246 @@ def run_observability_smoke(rng, baseline_qps=None) -> dict:
     return out
 
 
+def _ingest_stream_load(port, index, field, rng, n_records,
+                        n_rows=64, col_span=None, batch_records=50_000,
+                        stop_evt=None):
+    """Stream framed record batches at the binary ingest endpoint
+    (docs/ingest.md) until ``n_records`` are acked (or until
+    ``stop_evt`` is set, looping forever).  503s honor Retry-After and
+    resend the batch.  Returns {records, bytes, seconds, retries}."""
+    import http.client
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from pilosa_tpu.core import SHARD_WIDTH
+    from pilosa_tpu.ingest import wire
+
+    span = col_span if col_span is not None else SHARD_WIDTH
+    sent = sent_bytes = retries = 0
+    t0 = time.perf_counter()
+    while (stop_evt is not None and not stop_evt.is_set()) \
+            or (stop_evt is None and sent < n_records):
+        n = min(batch_records, max(n_records - sent, 1)) \
+            if stop_evt is None else batch_records
+        rows = rng.integers(0, n_rows, size=n)
+        cols = rng.integers(0, span, size=n)
+        body = wire.encode_records(rows, cols)
+        while True:
+            req = urllib.request.Request(
+                f"http://localhost:{port}/index/{index}/field/{field}"
+                f"/ingest", data=body, method="POST")
+            req.add_header("Content-Type", "application/octet-stream")
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    resp.read()
+                break
+            except urllib.error.HTTPError as e:
+                e.read()
+                if e.code != 503:
+                    raise
+                retries += 1
+                time.sleep(0.05)
+            except (OSError, http.client.HTTPException):
+                if stop_evt is not None and stop_evt.is_set():
+                    break  # server shutting down under us
+                raise
+        sent += n
+        sent_bytes += len(body)
+    return {"records": sent, "bytes": sent_bytes,
+            "seconds": time.perf_counter() - t0, "retries": retries}
+
+
+def bench_ingest(holder, executor, meta, rng):
+    """Streaming-ingest config (docs/ingest.md): sustained binary-frame
+    ingest alone, then ingest CONCURRENT with the intersect8 read leg —
+    the read-qps retention ratio is the read/write interference
+    headline (ROADMAP item 4: reads should hold >=80% of idle qps)."""
+    import tempfile
+    import threading
+
+    from pilosa_tpu.server import Config, Server
+
+    B, n_batches, T = 4096, 8, 8
+    n_rows = meta["star_rows"]
+
+    def read_batch():
+        sets = _rand_rows(rng, n_rows, B)
+        return " ".join(
+            "Count(Intersect(" + ", ".join(
+                f"Row(stargazer={r})" for r in q) + "))"
+            for q in sets)
+
+    def read_run():
+        batches = [read_batch() for _ in range(n_batches)]
+        return _run_batches(executor, "startrace", batches, T)
+
+    srv = Server(Config(data_dir=tempfile.mkdtemp(prefix="ptpu_bing_"),
+                        bind="localhost:0", anti_entropy_interval=0))
+    srv.holder.indexes = holder.indexes  # serve the bench data
+    srv.api.holder = holder
+    srv.committer.holder = holder
+    srv.open()
+    try:
+        idx = holder.index("startrace")
+        idx.create_field_if_not_exists("ingested")
+        executor.execute("startrace", read_batch())  # warm
+        (qps_idle, _b, _p), _sp = best_of(read_run, n=2)
+        # sustained ingest alone
+        alone = _ingest_stream_load(srv.port, "startrace", "ingested",
+                                    rng, 2_000_000)
+        # ingest concurrent with the read leg
+        stop = threading.Event()
+        conc: dict = {}
+        t = threading.Thread(
+            target=lambda: conc.update(_ingest_stream_load(
+                srv.port, "startrace", "ingested", rng, 0,
+                stop_evt=stop)))
+        t.start()
+        try:
+            (qps_load, _b2, _p2), _sp2 = best_of(read_run, n=2)
+        finally:
+            stop.set()
+            t.join(timeout=120)
+        ing = srv.committer.snapshot()
+        return {
+            "ingest_records_per_s": round(
+                alone["records"] / alone["seconds"], 1),
+            "ingest_mb_per_s": round(
+                alone["bytes"] / alone["seconds"] / 1e6, 2),
+            "ingest_retries": alone["retries"] + conc.get("retries", 0),
+            "concurrent_ingest_records_per_s": round(
+                conc["records"] / conc["seconds"], 1)
+            if conc.get("seconds") else 0.0,
+            "read_qps_idle": round(qps_idle, 1),
+            "read_qps_under_ingest": round(qps_load, 1),
+            "read_qps_retention": round(qps_load / qps_idle, 3),
+            "flushes": ing["flushes"],
+            "delta_folds": ing["folds"],
+        }
+    finally:
+        # NOT srv.close(): that would close the SHARED bench holder (the
+        # same reason bench_http only shuts the listener down)
+        srv.httpd.shutdown()
+        if hasattr(srv.httpd, "close_connections"):
+            srv.httpd.close_connections()
+        srv.httpd.server_close()
+        srv.committer.close()
+
+
+def run_ingest_smoke(rng) -> dict:
+    """Ingest leg of --smoke (docs/ingest.md): the same corpus through
+    the binary streaming endpoint and through the JSON bulk import must
+    answer identically — while the deltas are overlay-resident AND
+    after the merge folds them — plus a small read-under-ingest
+    retention measurement (the acceptance floor is judged on real
+    hardware by the full bench, not this CPU smoke)."""
+    import tempfile
+    import threading
+    import urllib.request
+
+    from pilosa_tpu.core import SHARD_WIDTH
+    from pilosa_tpu.server import Config, Server
+
+    srv = Server(Config(data_dir=tempfile.mkdtemp(prefix="ptpu_smki_"),
+                        bind="localhost:0", anti_entropy_interval=0,
+                        ingest_flush_ms=20.0))
+    srv.open()
+    try:
+        def post(path, body, ctype="application/json"):
+            req = urllib.request.Request(
+                f"http://localhost:{srv.port}{path}", method="POST",
+                data=body if isinstance(body, bytes) else body.encode())
+            req.add_header("Content-Type", ctype)
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.read()
+
+        post("/index/ings", "{}")
+        for f in ("fb", "fi", "readf"):
+            post(f"/index/ings/field/{f}", "{}")
+        n = 120_000
+        rows = rng.integers(0, 64, size=n)
+        cols = rng.integers(0, 2 * SHARD_WIDTH, size=n)
+        # read working set + its baseline qps
+        post("/index/ings/field/readf/import", json.dumps(
+            {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()}))
+        _http_count_load(srv.port, "ings", "readf", 64, rng, 8,
+                         per_thread=8)  # warm compiles
+        qps_idle, _ = _http_count_load(srv.port, "ings", "readf", 64,
+                                       rng, 8, per_thread=24)
+        # bulk twin
+        post("/index/ings/field/fb/import", json.dumps(
+            {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()}))
+
+        # streamed twin, concurrent with read load.  Two POSTs: the
+        # first establishes the fragments' row capacity (that flush
+        # folds — capacity growth changes device shapes), so the second
+        # exercises the delta-overlay journal.
+        half = n // 2
+        from pilosa_tpu.ingest import wire
+        post("/index/ings/field/fi/ingest",
+             wire.encode_records(rows[:half], cols[:half],
+                                 frame_records=10_000),
+             "application/octet-stream")
+        stop = threading.Event()
+        conc: dict = {}
+
+        def stream():
+            body = wire.encode_records(rows[half:], cols[half:],
+                                       frame_records=10_000)
+            t0 = time.perf_counter()
+            post("/index/ings/field/fi/ingest", body,
+                 "application/octet-stream")
+            conc["seconds"] = time.perf_counter() - t0
+            conc["bytes"] = len(body)
+            conc["records"] = n - half
+            stop.set()
+
+        t = threading.Thread(target=stream)
+        t.start()
+        qps_load, _ = _http_count_load(srv.port, "ings", "readf", 64,
+                                       rng, 8, per_thread=24)
+        t.join(timeout=300)
+        assert stop.is_set(), "ingest stream never completed"
+
+        def answers(field):
+            out = []
+            for r in (3, 17, 42):
+                out.append(json.loads(post(
+                    "/index/ings/query",
+                    f"Count(Row({field}={r}))"))["results"])
+            out.append(json.loads(post(
+                "/index/ings/query", f"TopN({field}, n=5)"))["results"])
+            return out
+
+        live_journal = sum(fr.delta_bytes()
+                           for *_x, fr in srv.holder.iter_fragments("ings"))
+        assert live_journal > 0, \
+            "second ingest stream never journaled a delta overlay"
+        got_live = answers("fi")
+        want = answers("fb")
+        assert got_live == want, \
+            "overlay-resident ingest answers diverged from bulk import"
+        srv.committer.merge_all()  # fold the overlays
+        assert answers("fi") == want, \
+            "post-merge ingest answers diverged from bulk import"
+        ing = srv.committer.snapshot()
+        return {
+            "records": n,
+            "records_per_s": round(conc["records"] / conc["seconds"], 1),
+            "ingest_mb_per_s": round(
+                conc["bytes"] / conc["seconds"] / 1e6, 2),
+            "read_qps_idle": round(qps_idle, 1),
+            "read_qps_under_ingest": round(qps_load, 1),
+            "read_qps_retention": round(qps_load / qps_idle, 3),
+            "overlay_journal_bytes": live_journal,
+            "flushes": ing["flushes"],
+            "answers_identical": True,
+        }
+    finally:
+        srv.close()
+
+
 def _smoke_norm(results):
     """TopN results -> comparable (id, count) lists."""
     return [[(p.id, p.count) for p in r] for r in results]
@@ -1426,6 +1666,7 @@ def run_smoke():
         DEFAULT_BUDGET.limit_bytes = old_limit
         ex5.close()
     out["compressed"] = run_compressed_smoke(np.random.default_rng(SEED + 6))
+    out["ingest"] = run_ingest_smoke(np.random.default_rng(SEED + 8))
     out["cache"] = run_cache_smoke(np.random.default_rng(SEED + 3))
     out["overload"] = run_overload_smoke()
     out["http_batch"] = run_http_batch_smoke(np.random.default_rng(SEED + 4))
@@ -1515,6 +1756,17 @@ def main():
         traceback.print_exc()
         http_batch = None
 
+    # streaming-ingest config (docs/ingest.md): sustained write rate and
+    # the read-qps retention under concurrent ingest
+    try:
+        ingest_leg = bench_ingest(holder, executor, meta,
+                                  np.random.default_rng(SEED + 8))
+    except Exception as e:
+        import traceback
+        print(f"ingest config failed: {e!r}", file=sys.stderr)
+        traceback.print_exc()
+        ingest_leg = None
+
     # HTTP variant (engine behind the real server)
     http_qps = None
     try:
@@ -1575,6 +1827,8 @@ def main():
         configs["2_http_path"] = {"qps": round(http_qps, 1)}
     if http_batch:
         configs["6_http_dynamic_batching"] = http_batch
+    if ingest_leg:
+        configs["8_streaming_ingest"] = ingest_leg
 
     print(json.dumps({
         "metric": "engine_intersect8_count_qps_1M_cols",

@@ -299,6 +299,17 @@ class API:
                                 payload.get("timestamps"),
                                 payload.get("clear", False))
 
+    def check_ingest(self, index: str, field: str) -> str:
+        """Validation head of the streaming ingest path (docs/ingest.md):
+        cluster-state gate + index/field existence.  The committer
+        applies records asynchronously, so unknown names must 404 at the
+        socket before any frame is read, not at flush time.  Returns the
+        field type so the handler can reject mismatched record types
+        (values frames at a set field and vice versa) per frame."""
+        self._validate("Import")
+        _idx, f = self._index_field(index, field)
+        return f.options.type
+
     def import_roaring(self, index: str, field: str, shard: int,
                        views: dict[str, bytes], clear: bool = False):
         """Import pre-serialized pilosa-roaring bitmaps, one per view

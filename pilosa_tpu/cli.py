@@ -127,6 +127,113 @@ def cmd_import(args) -> int:
     return 0
 
 
+def cmd_ingest(args) -> int:
+    """Stream a CSV/TSV file to the binary ingest endpoint
+    (docs/ingest.md): lines of ``row,col[,ts]`` (or ``col,value`` with
+    --field-type=int) are packed into length-prefixed CRC frames
+    (ingest/wire.py) and POSTed in bounded batches.  503 responses honor
+    Retry-After and resend the batch — frames are idempotent set
+    bits/values, so a resend after a mid-stream failure is safe.  A
+    progress line (records/s, MB/s, retries) goes to stderr."""
+    import time as _time
+    import urllib.error
+
+    from .ingest import wire
+
+    base = _base_url(args.host)
+    if args.create:
+        _http("POST", f"{base}/index/{args.index}",
+              json.dumps({}).encode(), ok_codes=(409,))
+        opts = {}
+        if args.field_type == "int":
+            opts = {"type": "int"}
+        elif args.field_type == "time":
+            opts = {"type": "time", "timeQuantum": args.time_quantum}
+        _http("POST", f"{base}/index/{args.index}/field/{args.field}",
+              json.dumps({"options": opts}).encode(), ok_codes=(409,))
+
+    url = f"{base}/index/{args.index}/field/{args.field}/ingest"
+    total = total_bytes = retries = 0
+    t0 = _time.perf_counter()
+    a_buf: list[int] = []
+    b_buf: list[int] = []
+    ts_buf: list[int] = []
+
+    def progress(final=False):
+        dt = max(_time.perf_counter() - t0, 1e-9)
+        line = (f"\r{total} records  {total / dt:,.0f} rec/s  "
+                f"{total_bytes / dt / 1e6:.1f} MB/s  retries {retries}")
+        print(line + ("\n" if final else ""), end="", file=sys.stderr,
+              flush=True)
+
+    def send():
+        nonlocal total, total_bytes, retries, a_buf, b_buf, ts_buf
+        if not b_buf:
+            return
+        if args.field_type == "int":
+            body = wire.encode_records(None, a_buf, values=b_buf)
+        else:
+            ts = ts_buf if any(ts_buf) else None
+            body = wire.encode_records(a_buf, b_buf, ts=ts)
+        for attempt in range(args.max_retries + 1):
+            req = urllib.request.Request(url, data=body, method="POST")
+            req.add_header("Content-Type", "application/octet-stream")
+            try:
+                with urllib.request.urlopen(req) as resp:
+                    resp.read()
+                break
+            except urllib.error.HTTPError as e:
+                e.read()
+                if e.code != 503 or attempt >= args.max_retries:
+                    raise SystemExit(
+                        f"\ningest: {e.code} {e.reason}")
+                retries += 1
+                try:
+                    wait = float(e.headers.get("Retry-After") or 1)
+                except (TypeError, ValueError):
+                    wait = 1.0
+                _time.sleep(min(wait, 30.0))
+            except (urllib.error.URLError, ConnectionError) as e:
+                # a dropped connection mid-batch is retryable too: the
+                # server only acks after its group commit, and frames
+                # are idempotent — resending cannot double-apply
+                if attempt >= args.max_retries:
+                    raise SystemExit(f"\ningest: {e}")
+                retries += 1
+                _time.sleep(1.0)
+        total += len(b_buf)
+        total_bytes += len(body)
+        a_buf, b_buf, ts_buf = [], [], []
+        progress()
+
+    files = args.files or ["-"]
+    for path in files:
+        fh = sys.stdin if path == "-" else open(path)
+        sep = None  # sniffed per file: TSV if the first line has a tab
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            if sep is None:
+                sep = "\t" if "\t" in line else ","
+            parts = line.split(sep)
+            if args.field_type == "int":
+                a_buf.append(int(parts[0]))   # col
+                b_buf.append(int(parts[1]))   # value
+            else:
+                a_buf.append(int(parts[0]))   # row
+                b_buf.append(int(parts[1]))   # col
+                ts_buf.append(int(parts[2]) if len(parts) > 2 else 0)
+            if len(b_buf) >= args.batch_size:
+                send()
+        if fh is not sys.stdin:
+            fh.close()
+    send()
+    progress(final=True)
+    print(f"ingested {total} records into {args.index}/{args.field}")
+    return 0
+
+
 def cmd_export(args) -> int:
     """(ctl/export.go:35-112).  Each shard is fetched from a node that
     OWNS it (ctl/export.go fragment-nodes routing) — a single-host fetch
@@ -308,6 +415,11 @@ max-op-n = 10000
 # dispatch-batch = true         # fuse compatible in-flight queries
 # dispatch-batch-max = 32       # queries per fused device launch
 # dispatch-batch-window-us = 200  # max solo wait for batch company
+# streaming ingest (docs/ingest.md)
+# ingest-flush-ms = 50     # group-commit window: one WAL frame + one gen
+#                          # bump per fragment per flush
+# ingest-delta-mb = 64     # device delta-overlay journal budget, 0 = off
+# ingest-max-frame-mb = 32 # per-frame ceiling on the ingest wire
 # query cache subsystem (docs/caching.md)
 # result-cache-mb = 256    # generation-keyed result cache budget, 0 = off
 # rank-rebuild-rows = 4096 # incremental rank-cache ceiling per batch
@@ -367,6 +479,9 @@ def cmd_config(args) -> int:
     print(f"compressed-resident = {str(cfg.compressed_resident).lower()}")
     print(f"compress-max-density = {cfg.compress_max_density}")
     print(f"decode-workspace-mb = {cfg.decode_workspace_mb}")
+    print(f"ingest-flush-ms = {cfg.ingest_flush_ms}")
+    print(f"ingest-delta-mb = {cfg.ingest_delta_mb}")
+    print(f"ingest-max-frame-mb = {cfg.ingest_max_frame_mb}")
     print(f"max-body-mb = {cfg.max_body_mb}")
     print(f"result-cache-mb = {cfg.result_cache_mb}")
     print(f"rank-rebuild-rows = {cfg.rank_rebuild_rows}")
@@ -437,6 +552,25 @@ def main(argv=None) -> int:
                          "importBufferSize)")
     sp.add_argument("files", nargs="*")
     sp.set_defaults(fn=cmd_import)
+
+    sp = sub.add_parser("ingest",
+                        help="stream CSV/TSV to the binary ingest "
+                             "endpoint")
+    sp.add_argument("-host", default="localhost:10101")
+    sp.add_argument("-i", "--index", required=True)
+    sp.add_argument("-f", "--field", required=True)
+    sp.add_argument("--create", action="store_true",
+                    help="create index/field if missing")
+    sp.add_argument("--field-type", default="set",
+                    choices=["set", "int", "time"])
+    sp.add_argument("--time-quantum", default="YMD")
+    sp.add_argument("--batch-size", type=int, default=200_000,
+                    help="records per POST (each POST is one framed "
+                         "stream; 503s resend the whole batch)")
+    sp.add_argument("--max-retries", type=int, default=8,
+                    help="503 retries per batch before giving up")
+    sp.add_argument("files", nargs="*")
+    sp.set_defaults(fn=cmd_ingest)
 
     sp = sub.add_parser("export", help="export a field as CSV")
     sp.add_argument("-host", default="localhost:10101")

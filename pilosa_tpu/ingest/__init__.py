@@ -1,0 +1,19 @@
+"""Streaming ingest subsystem (docs/ingest.md).
+
+The production write path: length-prefixed binary frames off the socket
+(``wire``), per-fragment group commit — one WAL frame, one generation
+bump, one rank-cache touch per flush, not per request (``committer``) —
+and HBM delta overlays so freshly ingested bits reach queries without
+re-staging whole fragments (``delta`` + parallel/mesh_exec.py).
+"""
+
+from .committer import GroupCommitter
+from .wire import (FrameError, FrameReader, MAGIC, REC_BITS, REC_BITS_TS,
+                   REC_VALS, encode_frame, encode_records, pack_bits,
+                   pack_values)
+
+__all__ = [
+    "GroupCommitter", "FrameError", "FrameReader", "MAGIC",
+    "REC_BITS", "REC_BITS_TS", "REC_VALS",
+    "encode_frame", "encode_records", "pack_bits", "pack_values",
+]

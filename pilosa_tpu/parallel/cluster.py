@@ -76,6 +76,14 @@ class ClusterError(RuntimeError):
     pass
 
 
+class IngestBackpressure(ClusterError):
+    """A forwarded ingest batch was refused 503 by the shard owner (its
+    group-commit backlog is over high-water).  The coordinator maps this
+    back to its own 503 + Retry-After so the producer backs off the
+    whole (idempotent) stream — backpressure propagates end-to-end
+    instead of queueing invisibly (docs/ingest.md)."""
+
+
 class CircuitOpenError(ClusterError):
     """Fail-fast rejection: the target peer's circuit breaker is open
     (N consecutive transport failures).  A ClusterError subclass so
@@ -541,6 +549,45 @@ class InternalClient:
         (http/client.go Import; applied locally, never re-forwarded)."""
         self._json(host, "POST",
                    f"/internal/import/{index}/{field}", payload)
+
+    def ingest_frames(self, host: str, index: str, field: str,
+                      body: bytes, timeout: float | None = None) -> dict:
+        """Forward routed ingest frames to a shard owner as a binary
+        stream (docs/ingest.md): ``body`` is magic + frames, exactly the
+        public wire format.  Returns after the OWNER's group commit
+        acked; a 503 surfaces as IngestBackpressure so the coordinator
+        can push back to its own producer."""
+        status, data = self._request(
+            host, "POST", f"/internal/ingest/{index}/{field}", body,
+            ctype="application/octet-stream", timeout=timeout)
+        if status == 503:
+            raise IngestBackpressure(
+                f"{host}: ingest backlog over high-water")
+        if status >= 400:
+            try:
+                msg = json.loads(data).get("error", data.decode())
+            except Exception:
+                msg = data.decode(errors="replace")
+            raise ClusterError(f"{host} ingest: {status} {msg}")
+        return json.loads(data) if data else {}
+
+    def import_roaring_binary(self, host: str, index: str, field: str,
+                              shard: int, view: str, data: bytes,
+                              clear: bool):
+        """Forward one view's roaring blob raw — the node-to-node half
+        of killing the 4/3 base64-in-JSON blowup on roaring imports."""
+        status, resp = self._request(
+            host, "POST",
+            f"/internal/import-roaring/{index}/{field}/{shard}"
+            f"?view={view}&clear={'true' if clear else 'false'}",
+            data, ctype="application/octet-stream")
+        if status >= 400:
+            try:
+                msg = json.loads(resp).get("error", resp.decode())
+            except Exception:
+                msg = resp.decode(errors="replace")
+            raise ClusterError(
+                f"{host} import-roaring: {status} {msg}")
 
     def available_shards(self, host: str, index: str) -> list[int]:
         out = self._json(host, "GET", f"/internal/index/{index}/shards")
@@ -1876,12 +1923,20 @@ class Cluster:
 
     def import_roaring(self, index: str, field: str, shard: int,
                        views: dict[str, bytes], clear: bool):
-        """Forward a pre-serialized roaring import to each shard owner."""
+        """Forward a pre-serialized roaring import to each shard owner.
+        Single-view imports (the overwhelmingly common shape) ship RAW
+        over /internal/import-roaring — no base64, no JSON envelope;
+        multi-view imports keep the legacy JSON forward."""
         self.note_peer_write(index, self.placement.shard_nodes(index, shard))
         for nid in self.placement.shard_nodes(index, shard):
             if nid == self.node_id:
                 self.api.apply_import_roaring_local(index, field, shard,
                                                     views, clear)
+            elif len(views) == 1:
+                (view, data), = views.items()
+                self.client.import_roaring_binary(
+                    self.by_id[nid].host, index, field, shard,
+                    view or "standard", data, clear)
             else:
                 payload = {
                     "shard": shard,
@@ -2702,6 +2757,20 @@ class Cluster:
 
         router.add("POST", "/internal/import/{index}/{field}",
                    internal_import)
+
+        def internal_import_roaring(req, args):
+            """Raw roaring blob, one view per POST (the binary forward
+            half of the octet-stream import path; docs/ingest.md)."""
+            view = req.query.get("view", ["standard"])[0]
+            clear = req.query.get("clear", ["false"])[0] == "true"
+            cluster.api.apply_import_roaring_local(
+                args["index"], args["field"], int(args["shard"]),
+                {view: req.body}, clear)
+            return {}
+
+        router.add("POST",
+                   "/internal/import-roaring/{index}/{field}/{shard}",
+                   internal_import_roaring)
 
         def internal_translate(req, args):
             """Coordinator-side key<->id service (http/translator.go)."""

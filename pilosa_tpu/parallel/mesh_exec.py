@@ -399,7 +399,7 @@ class MeshExecutor:
         pop entries concurrently from outside ``self._lock`` (it must not
         lock: two executors evicting each other's entries would deadlock),
         so every cache op here tolerates a vanished key."""
-        frags, token = self._stack_token(keys, holder, index, shards)
+        frags, token, epochs = self._stack_token(keys, holder, index, shards)
         ckey = (index, tuple(keys), tuple(shards))
         skey = ("stack", id(self), ckey)
         with self._sc_lock:
@@ -407,8 +407,22 @@ class MeshExecutor:
             if cached is not None and cached[0] == token:
                 self._stack_cache.move_to_end(ckey)
         if cached is not None and cached[0] == token:
-            self._budget.touch(skey)
-            return cached[1]
+            if cached[2] != epochs:
+                # ingest delta overlay (docs/ingest.md): the stack is
+                # current at its device_gen token but member fragments
+                # have journaled flushes since — OR the missing chunks
+                # into the resident stacked blocks on device instead of
+                # rebuilding/re-uploading them.  Multi-process meshes
+                # rebuild instead (their staging must stay deterministic
+                # across processes).
+                if self.multiprocess:
+                    cached = None
+                else:
+                    self._refresh_overlays(ckey, token, frags, shards,
+                                           keys, epochs)
+            if cached is not None:
+                self._budget.touch(skey)
+                return cached[1]
 
         groups: dict[tuple, list[tuple[int, list]]] = {}
         for shard, row in zip(shards, frags):
@@ -486,7 +500,7 @@ class MeshExecutor:
                         del s._stack_cache[ck]
 
         with self._sc_lock:
-            self._stack_cache[ckey] = (token, out)
+            self._stack_cache[ckey] = (token, out, epochs)
             trimmed = []
             while len(self._stack_cache) > self.stack_cache_max:
                 trimmed.append(self._stack_cache.popitem(last=False)[0])
@@ -497,27 +511,122 @@ class MeshExecutor:
         return out
 
     def _stack_token(self, keys, holder, index, shards):
-        """(per-shard fragment rows, data-generation token) for a stacked
-        block — the token keys cache validity (gens are unique per
-        mutation, so equality means identical data).  The device form
-        rides along: a budget-limit change can flip a fragment between
-        dense and compressed residency, and a stale-form stack would
-        silently keep the old footprint."""
+        """(per-shard fragment rows, device-generation token, ingest
+        epochs) for a stacked block.  The token keys cache validity
+        against ``fr.device_gen`` — the generation the device-resident
+        form reflects — so an ingest flush (which bumps ``gen`` but
+        journals its delta instead of invalidating device state,
+        docs/ingest.md) does NOT rebuild the stack; the epochs vector
+        tells ``_placed_groups`` which journal chunks to overlay in.
+        Any non-ingest mutation re-anchors device_gen = gen and the
+        token mismatch rebuilds as before.  The device form rides
+        along: a budget-limit change can flip a fragment between dense
+        and compressed residency, and a stale-form stack would silently
+        keep the old footprint."""
         frags = [[holder.fragment(index, field, view, shard)
                   for field, view in keys] for shard in shards]
         token = tuple(
-            -1 if fr is None else (fr.gen, self._frag_sig(fr)[0])
+            -1 if fr is None else (fr.device_gen, self._frag_sig(fr)[0])
             for row in frags for fr in row)
-        return frags, token
+        epochs = tuple(
+            0 if fr is None else fr.ingest_epoch
+            for row in frags for fr in row)
+        return frags, token, epochs
 
     def _is_resident(self, keys, holder, index, shards) -> bool:
         """Whether this (keys, shards) stack is cached AND current — the
         residency signal the streaming scheduler orders slices by."""
-        _, token = self._stack_token(keys, holder, index, shards)
+        _, token, _epochs = self._stack_token(keys, holder, index, shards)
         with self._sc_lock:
             cached = self._stack_cache.get(
                 (index, tuple(keys), tuple(shards)))
+        # an epoch lag still counts as resident: the overlay scatter is
+        # a few KB of device work, not a re-stage
         return cached is not None and cached[0] == token
+
+    # -- ingest delta overlay (docs/ingest.md) -----------------------------
+
+    def _refresh_overlays(self, ckey, token, frags, shards, keys,
+                          new_epochs):
+        """OR journaled ingest flushes into the resident stacked blocks
+        of a token-valid cache entry.  Per dense group/key: gather every
+        member fragment's unseen journal chunks, dedupe host-side, and
+        run one scatter-OR shard_map program over the stacked array —
+        KBs of overlay transfer instead of a full re-stage.  Compressed
+        ('z') entries never appear here (their fragments fold instead
+        of journaling).  Serialized under the executor lock; a racing
+        duplicate application is harmless (OR of already-present bits
+        contributes nothing)."""
+        from ..ingest.delta import merge_chunks
+        nk = len(keys)
+        row_of = {s: i for i, s in enumerate(shards)}
+        with self._lock:
+            with self._sc_lock:
+                cur = self._stack_cache.get(ckey)
+            if cur is None or cur[0] != token or cur[2] == new_epochs:
+                return
+            out, old_epochs = cur[1], cur[2]
+            for shard_list, placed, sig in out:
+                for ki in range(nk):
+                    s_k = sig[ki]
+                    if s_k is None or s_k[0] == "z":
+                        continue
+                    members, idxs, vals = [], [], []
+                    for j, shard in enumerate(shard_list):
+                        fr = frags[row_of[shard]][ki]
+                        if fr is None:
+                            continue
+                        ep = old_epochs[row_of[shard] * nk + ki]
+                        di, dv = merge_chunks(fr.delta_chunks(ep))
+                        if di.size:
+                            members.append(
+                                np.full(di.size, j, dtype=np.int32))
+                            idxs.append(di)
+                            vals.append(dv)
+                    if not members:
+                        continue
+                    placed[ki] = self._overlay_stack(
+                        placed[ki], np.concatenate(members),
+                        np.concatenate(idxs), np.concatenate(vals))
+            with self._sc_lock:
+                cur2 = self._stack_cache.get(ckey)
+                if cur2 is not None and cur2[0] == token:
+                    self._stack_cache[ckey] = (token, out, new_epochs)
+
+    def _overlay_stack(self, stacked, member, flat_idx, vals):
+        """One scatter-OR launch: ``stacked`` is the mesh-sharded
+        [S, rows, W] block; (member, flat_idx, vals) name the overlay
+        words.  Indices ship as (member, row, word) int32 triples (a
+        flattened int64 offset would exceed jax's default index width on
+        large fragments) and the add-of-missing-bits formulation keeps
+        padding collisions harmless (ingest/delta.py).  Not routed
+        through _InstrumentedExec: its shard/padding attribution reads
+        reducer-shaped args, and a KB-scale maintenance scatter would
+        only pollute the launch ledger."""
+        from ..ingest.delta import pad_overlay
+        m, r, w, v = pad_overlay(flat_idx, vals, SHARD_WORDS,
+                                 member=member)
+        key = ("overlay", tuple(stacked.shape), m.size)
+        fn = self._cache.get(key)
+        if fn is None:
+            def block_fn(block, m_, r_, w_, v_):
+                s_local = block.shape[0]
+                base = jax.lax.axis_index(SHARD_AXIS) * s_local
+                loc = m_ - base
+                ok = (loc >= 0) & (loc < s_local)
+                loc = jnp.where(ok, loc, 0)
+                cur = block[loc, r_, w_]
+                contrib = jnp.where(ok, v_ & ~cur, jnp.uint32(0))
+                return block.at[loc, r_, w_].add(contrib)
+
+            fn = jax.jit(_shard_map(
+                block_fn, mesh=self.mesh,
+                in_specs=(P(SHARD_AXIS), P(), P(), P(), P()),
+                out_specs=P(SHARD_AXIS),
+                **{_SM_CHECK_KW: True}))
+            self._cache[key] = fn
+        with _DISPATCH_LOCK:
+            return fn(stacked, m, r, w, v)
 
     @staticmethod
     def _cleanup_budget(budget, exec_id, stack_cache):

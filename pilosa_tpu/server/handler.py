@@ -14,6 +14,8 @@ import json
 import re
 import time
 import traceback
+
+import numpy as np
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -83,25 +85,33 @@ class Router:
     rides the separate node-to-node pool (a coordinator holding a public
     slot fans out to peers whose internal handling must never queue
     behind their public traffic — otherwise concurrent coordinators
-    could deadlock the cluster against itself)."""
+    could deadlock the cluster against itself); "ingest" rides a third
+    pool so sustained writes can never starve reads of their slots
+    (docs/ingest.md).
+
+    ``stream`` routes read their body incrementally off the socket
+    themselves (``req.rfile`` + ``req._stream_len``) — the handler never
+    buffers it, so a multi-GB ingest stream costs one frame of memory."""
 
     def __init__(self):
-        self.routes: list[tuple[str, re.Pattern, callable, str | None]] = []
+        self.routes: list[tuple] = []
 
-    def add(self, method: str, pattern: str, fn, gate: str | None = None):
+    def add(self, method: str, pattern: str, fn, gate: str | None = None,
+            stream: bool = False):
         rx = re.compile("^" + re.sub(
             r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$")
-        self.routes.append((method, rx, fn, gate))
+        self.routes.append((method, rx, fn, gate, stream))
 
     def match(self, method: str, path: str):
         found_path = False
-        for m, rx, fn, gate in self.routes:
+        for m, rx, fn, gate, stream in self.routes:
             mt = rx.match(path)
             if mt:
                 found_path = True
                 if m == method:
-                    return fn, mt.groupdict(), gate
-        return ("method_not_allowed" if found_path else None), {}, None
+                    return fn, mt.groupdict(), gate, stream
+        return ("method_not_allowed" if found_path else None), {}, \
+            None, False
 
 
 def build_router(api: API, server=None) -> Router:
@@ -217,19 +227,195 @@ def build_router(api: API, server=None) -> Router:
     def post_import_roaring(req, args):
         clear = req.query.get("clear", ["false"])[0] == "true"
         ctype = req.headers.get("Content-Type", "")
-        if ctype.startswith("application/json"):
+        # Content-Type sniff: the base64-JSON envelope stays for
+        # compatibility, but a raw roaring body (it can never start with
+        # "{" — the roaring cookie's low byte is 0x3A..0x3C) is imported
+        # directly even under a lying JSON header, so no client is ever
+        # forced through the 4/3 base64 blowup + JSON parse.
+        is_json = ctype.startswith("application/json") and \
+            req.body.lstrip()[:1] == b"{"
+        if is_json:
             import base64
             body = req.json()
             views = {k: base64.b64decode(v)
                      for k, v in body.get("views", {}).items()}
         else:
-            views = {"standard": req.body}
+            view = req.query.get("view", ["standard"])[0]
+            views = {view: req.body}
         api.import_roaring(args["index"], args["field"],
                            int(args["shard"]), views, clear=clear)
         return {}
 
     r.add("POST", "/index/{index}/field/{field}/import-roaring/{shard}",
           post_import_roaring)
+
+    # -- streaming ingest (docs/ingest.md) ---------------------------------
+
+    def _ingest_stream(req, args, forward: bool):
+        """Shared body of the public and /internal/ ingest routes: read
+        binary frames incrementally off the socket, route records to
+        shard owners (public only), group-commit local records, and ack
+        only after the covering flush hit the WAL."""
+        from ..ingest import wire
+        from ..parallel.cluster import IngestBackpressure
+
+        index, field = args["index"], args["field"]
+        ftype = api.check_ingest(index, field)
+        committer = getattr(server, "committer", None) \
+            if server is not None else None
+        if committer is None:
+            raise ApiError("streaming ingest requires a running server")
+        cluster = getattr(server, "cluster", None)
+        from ..core import SHARD_WIDTH
+        reader = wire.FrameReader(req.rfile.read, req._stream_len,
+                                  max_frame_bytes=req.ingest_max_frame_bytes)
+        frames = records = fwd_records = 0
+        last_seq = 0
+        # per-peer forward buffers: re-encoded frames accumulate until
+        # FWD_FLUSH_BYTES, then ship as one /internal/ingest POST (the
+        # peer acks after ITS group commit, so the ack chain holds
+        # end-to-end)
+        fwd: dict[str, list[bytes]] = {}
+        fwd_bytes: dict[str, int] = {}
+        FWD_FLUSH_BYTES = 1 << 20
+        local_id = cluster.node_id if cluster is not None else None
+
+        def submit(recs, rectype) -> None:
+            nonlocal last_seq
+            if rectype == wire.REC_VALS:
+                last_seq = committer.submit(index, field,
+                                            cols=recs["col"],
+                                            values=recs["value"])
+            else:
+                ts = recs["ts"] if rectype == wire.REC_BITS_TS else None
+                last_seq = committer.submit(index, field,
+                                            rows=recs["row"],
+                                            cols=recs["col"], ts=ts)
+
+        def ship(host: str):
+            payload = b"".join([wire.MAGIC] + fwd.pop(host))
+            fwd_bytes.pop(host, None)
+            try:
+                cluster.client.ingest_frames(host, index, field, payload)
+            except IngestBackpressure as e:
+                # the owner's backlog is full: propagate the 503 so the
+                # client backs off the whole stream (frames are
+                # idempotent — resending is safe)
+                raise AdmissionRejected(str(e), retry_after=1)
+
+        try:
+            while True:
+                # backpressure: a slow device merge keeps the committer
+                # backlog high, which parks the socket read here and
+                # eventually turns into a retryable 503
+                if not committer.wait_capacity():
+                    if req.stats is not None:
+                        req.stats.count("ingest.rejected")
+                    raise AdmissionRejected(
+                        "ingest backlog over high-water; retry",
+                        retry_after=1)
+                item = reader.next_frame()
+                if item is None:
+                    break
+                rectype, recs, nbytes = item
+                # per-frame validation at the socket: the committer
+                # applies asynchronously and shares a flush across
+                # producers, so bad records must 400 HERE, not poison a
+                # flush.  Negative ids are rejected outright — a
+                # negative row would wrap through the device overlay
+                # scatter into the wrong rows of resident state.
+                if (rectype == wire.REC_VALS) != (ftype == "int"):
+                    raise ApiError(
+                        f"record type {rectype} does not match field "
+                        f"type {ftype!r} (values frames require an int "
+                        f"field, bit frames a non-int field)")
+                if len(recs):
+                    if int(recs["col"].min()) < 0:
+                        raise ApiError("negative column id in ingest "
+                                       "frame")
+                    if rectype != wire.REC_VALS \
+                            and int(recs["row"].min()) < 0:
+                        raise ApiError("negative row id in ingest frame")
+                    if rectype == wire.REC_BITS_TS \
+                            and int(recs["ts"].min()) < 0:
+                        raise ApiError("negative timestamp in ingest "
+                                       "frame")
+                frames += 1
+                records += len(recs)
+                if req.stats is not None:
+                    req.stats.count("ingest.frames")
+                    req.stats.count("ingest.records", len(recs))
+                    req.stats.count("ingest.bytes", nbytes)
+                if cluster is None or not forward:
+                    submit(recs, rectype)
+                    continue
+                shards = recs["col"] // SHARD_WIDTH
+                idx_obj = api.holder.index(index)
+                f_obj = idx_obj.field(field) if idx_obj is not None \
+                    else None
+                by_node: dict[str, list[int]] = {}
+                for s in np.unique(shards):
+                    for nid in cluster.placement.shard_nodes(index,
+                                                             int(s)):
+                        by_node.setdefault(nid, []).append(int(s))
+                cluster.note_peer_write(index, by_node)
+                for nid, nshards in by_node.items():
+                    sub = recs[np.isin(shards, nshards)]
+                    if nid == local_id:
+                        submit(sub, rectype)
+                        continue
+                    fwd_records += len(sub)
+                    host = cluster.by_id[nid].host
+                    payload = wire.encode_frame(bytes([rectype])
+                                                + sub.tobytes())
+                    fwd.setdefault(host, []).append(payload)
+                    fwd_bytes[host] = fwd_bytes.get(host, 0) \
+                        + len(payload)
+                    if f_obj is not None:
+                        f_obj.remote_available_shards.update(
+                            s for s in nshards
+                            if not cluster.placement.owns_shard(
+                                local_id, index, s))
+                    if fwd_bytes[host] >= FWD_FLUSH_BYTES:
+                        ship(host)
+            for host in list(fwd):
+                ship(host)
+        except Exception:
+            # Drain a bounded amount of the unread stream first: closing
+            # with unread receive data resets the connection, and the
+            # RST would destroy the 400/503 response (and its
+            # Retry-After) before the client reads it — the same
+            # courtesy the 413 path extends.  The connection still
+            # closes (mid-stream state cannot be resynced).
+            remaining = min(reader.remaining, 64 << 20)
+            while remaining > 0:
+                chunk = req.rfile.read(min(remaining, 1 << 20))
+                if not chunk:
+                    break
+                remaining -= len(chunk)
+            req.close_connection = True
+            raise
+        if last_seq and not committer.wait_flushed(last_seq):
+            req.close_connection = True
+            raise AdmissionRejected(
+                "ingest flush did not complete in time; retry",
+                retry_after=1)
+        return {"frames": frames, "records": records,
+                "forwarded": fwd_records}
+
+    def post_ingest(req, args):
+        return _ingest_stream(req, args, forward=True)
+
+    r.add("POST", "/index/{index}/field/{field}/ingest", post_ingest,
+          gate="ingest", stream=True)
+
+    def post_ingest_internal(req, args):
+        # receive side of the ingest forward: the sender already routed,
+        # never re-forward
+        return _ingest_stream(req, args, forward=False)
+
+    r.add("POST", "/internal/ingest/{index}/{field}", post_ingest_internal,
+          gate="ingest", stream=True)
 
     def get_export(req, args):
         index = req.query.get("index", [""])[0]
@@ -337,6 +523,12 @@ def build_router(api: API, server=None) -> Router:
         from ..utils import devobs
         out["device"] = {"compiles": devobs.COMPILES.totals(),
                          "launches": devobs.LEDGER.aggregates()}
+        # streaming ingest (docs/ingest.md): group-commit backlog, flush
+        # counters, and the delta-overlay journal footprint
+        committer = getattr(server, "committer", None) \
+            if server is not None else None
+        if committer is not None:
+            out["ingest"] = committer.snapshot()
         ts = getattr(server, "timeseries", None) if server is not None \
             else None
         if ts is not None:
@@ -533,6 +725,11 @@ class _HandlerClass(BaseHTTPRequestHandler):
     # StatsClient for the 503/504 counters.
     admission = None
     admission_internal = None
+    # Streaming ingest (docs/ingest.md): its own slot pool (writes must
+    # not starve reads or the /internal/ plane) and the per-frame byte
+    # ceiling (ingest-max-frame-mb).
+    admission_ingest = None
+    ingest_max_frame_bytes: int = 32 << 20
     default_query_timeout: float = 0.0
     stats = None
     # Observability (docs/observability.md).  slowlog: SlowQueryLog ring
@@ -566,31 +763,43 @@ class _HandlerClass(BaseHTTPRequestHandler):
             self.close_connection = True
             self._send(400, {"error": "invalid Content-Length"})
             return
-        # /internal/ routes trade the public ceiling for the (bounded)
-        # internal one — see max_body_bytes_internal above
-        # (docs/configuration.md max-body-mb)
-        limit = self.max_body_bytes
-        if limit > 0 and parsed.path.startswith("/internal/"):
-            # 0 on the internal knob = same ceiling as the public surface
-            if self.max_body_bytes_internal > 0:
-                limit = max(limit, self.max_body_bytes_internal)
-        if 0 < limit < length:
-            # answer 413, then drain a bounded amount of the in-flight
-            # body so the client sees the response instead of an RST
-            # (closing with unread receive data resets the connection);
-            # bodies beyond the drain cap close hard anyway
-            self._send(413, {"error": f"request body {length} bytes "
-                             f"exceeds limit {limit}"})
-            self.close_connection = True
-            remaining = min(length, 64 << 20)
-            while remaining > 0:
-                chunk = self.rfile.read(min(remaining, 1 << 20))
-                if not chunk:
-                    break
-                remaining -= len(chunk)
-            return
-        self.body = self.rfile.read(length) if length > 0 else b""
-        fn, args, gate = self.router.match(method, parsed.path)
+        fn, args, gate, stream = self.router.match(method, parsed.path)
+        stream = stream and not isinstance(fn, str) and fn is not None
+        if stream:
+            # streaming route (ingest): the handler fn reads frames
+            # incrementally off the socket itself — the whole-body
+            # ceiling doesn't apply (per-frame bounds do, wire.py); the
+            # fn closes the connection on any mid-stream failure rather
+            # than trying to resync the keep-alive stream
+            self.body = b""
+            self._stream_len = length
+        else:
+            # /internal/ routes trade the public ceiling for the
+            # (bounded) internal one — see max_body_bytes_internal above
+            # (docs/configuration.md max-body-mb)
+            limit = self.max_body_bytes
+            if limit > 0 and parsed.path.startswith("/internal/"):
+                # 0 on the internal knob = same ceiling as the public
+                # surface
+                if self.max_body_bytes_internal > 0:
+                    limit = max(limit, self.max_body_bytes_internal)
+            if 0 < limit < length:
+                # answer 413, then drain a bounded amount of the
+                # in-flight body so the client sees the response instead
+                # of an RST (closing with unread receive data resets the
+                # connection); bodies beyond the drain cap close hard
+                # anyway
+                self._send(413, {"error": f"request body {length} bytes "
+                                 f"exceeds limit {limit}"})
+                self.close_connection = True
+                remaining = min(length, 64 << 20)
+                while remaining > 0:
+                    chunk = self.rfile.read(min(remaining, 1 << 20))
+                    if not chunk:
+                        break
+                    remaining -= len(chunk)
+                return
+            self.body = self.rfile.read(length) if length > 0 else b""
         # handler.go:231 extract — the header carries
         # trace_id:parent_span_id[:0], so a remote hop's spans parent
         # under the coordinator's rpc span (docs/observability.md)
@@ -647,7 +856,8 @@ class _HandlerClass(BaseHTTPRequestHandler):
                                     and self.slowlog.enabled):
                     prof = qprof.QueryProfile()
             adm = self.admission if gate == "query" else \
-                self.admission_internal if gate == "internal" else None
+                self.admission_internal if gate == "internal" else \
+                self.admission_ingest if gate == "ingest" else None
             admitted = False
             if adm is not None:
                 # slot wait is the first profile stage: under overload
@@ -862,6 +1072,8 @@ def make_http_server(api: API, host: str = "localhost", port: int = 10101,
                      max_body_bytes: int | None = None,
                      max_body_bytes_internal: int | None = None,
                      admission=None, admission_internal=None,
+                     admission_ingest=None,
+                     ingest_max_frame_bytes: int | None = None,
                      default_query_timeout: float | None = None,
                      slowlog=None, profile_default: bool | None = None,
                      ) -> ThreadingHTTPServer:
@@ -882,6 +1094,10 @@ def make_http_server(api: API, host: str = "localhost", port: int = 10101,
         attrs["admission"] = admission
     if admission_internal is not None:
         attrs["admission_internal"] = admission_internal
+    if admission_ingest is not None:
+        attrs["admission_ingest"] = admission_ingest
+    if ingest_max_frame_bytes is not None:
+        attrs["ingest_max_frame_bytes"] = ingest_max_frame_bytes
     if default_query_timeout is not None:
         attrs["default_query_timeout"] = default_query_timeout
     if slowlog is not None:

@@ -65,6 +65,19 @@ class Config:
     # Per-launch dense decode workspace ceiling (MB): shard slices are
     # cut so one launch never decodes more dense tile bytes than this.
     decode_workspace_mb: int = 1024
+    # -- streaming ingest (docs/ingest.md) ---------------------------------
+    # Group-commit window: milliseconds the committer lets submissions
+    # coalesce before flushing (one WAL frame + one gen bump + one
+    # rank-cache touch per fragment per flush).  <= 0 flushes inline.
+    ingest_flush_ms: float = 50.0
+    # Process-wide budget for ingest delta-overlay journals — the bits
+    # OR'd into resident device state between folds.  Over it (or per
+    # fragment over an eighth of it) journals fold and device forms
+    # rebuild from the sparse store.  0 disables overlays entirely.
+    ingest_delta_mb: int = 64
+    # Per-frame ceiling on the ingest wire (a frame buffers whole for
+    # its CRC, so this bounds per-connection memory).
+    ingest_max_frame_mb: int = 32
     # monitors / metrics (reference server/config.go metric section)
     anti_entropy_interval: float = 600.0
     metric_poll_interval: float = 60.0
@@ -204,6 +217,10 @@ class Config:
                                                 float),
             "PILOSA_TPU_DECODE_WORKSPACE_MB": ("decode_workspace_mb",
                                                int),
+            "PILOSA_TPU_INGEST_FLUSH_MS": ("ingest_flush_ms", float),
+            "PILOSA_TPU_INGEST_DELTA_MB": ("ingest_delta_mb", int),
+            "PILOSA_TPU_INGEST_MAX_FRAME_MB": ("ingest_max_frame_mb",
+                                               int),
             "PILOSA_TPU_METRIC_SERVICE": ("metric_service", str),
             "PILOSA_TPU_METRIC_HOST": ("metric_host", str),
             "PILOSA_TPU_DIAGNOSTICS_ENDPOINT": ("diagnostics_endpoint",
@@ -272,6 +289,9 @@ class Config:
             "compressed-resident": "compressed_resident",
             "compress-max-density": "compress_max_density",
             "decode-workspace-mb": "decode_workspace_mb",
+            "ingest-flush-ms": "ingest_flush_ms",
+            "ingest-delta-mb": "ingest_delta_mb",
+            "ingest-max-frame-mb": "ingest_max_frame_mb",
             "max-body-mb": "max_body_mb",
             "max-body-internal-mb": "max_body_internal_mb",
             "query-timeout": "query_timeout",
@@ -351,6 +371,11 @@ class Server:
         from ..parallel import mesh_exec as _mesh_exec
         _mesh_exec.DECODE_WORKSPACE_BYTES = \
             max(self.config.decode_workspace_mb, 1) << 20
+        # streaming ingest (docs/ingest.md): the delta-overlay budget is
+        # process-wide like the others (most recent Server wins)
+        from ..storage import membudget as _membudget
+        _membudget.INGEST_DELTA_LIMIT_BYTES = \
+            max(self.config.ingest_delta_mb, 0) << 20
         data_dir = os.path.expanduser(self.config.data_dir)
         self.holder = Holder(
             data_dir, max_op_n=self.config.max_op_n,
@@ -414,6 +439,19 @@ class Server:
         self.admission_internal = AdmissionController(
             self.config.max_queries, self.config.queue_timeout,
             stats=self.stats, name="internal")
+        # Third pool for streaming ingest (docs/ingest.md): sustained
+        # writes must not occupy read slots, and forwarded-ingest
+        # handling on a peer must not queue behind ITS public writes
+        # either (forwards never re-forward, so depth-1 sharing is
+        # deadlock-free).
+        self.admission_ingest = AdmissionController(
+            self.config.max_queries, self.config.queue_timeout,
+            stats=self.stats, name="ingest")
+        # Group committer: the write path's flush/merge engine.
+        from ..ingest import GroupCommitter
+        self.committer = GroupCommitter(
+            self.holder, flush_ms=self.config.ingest_flush_ms,
+            stats=self.stats)
         # Observability (docs/observability.md): the slow-query ring +
         # the trace-sampling decision.  The tracer is process-wide like
         # the memory budgets — the most recent Server's config wins.
@@ -447,6 +485,9 @@ class Server:
             max_body_bytes_internal=self.config.max_body_internal_mb << 20,
             admission=self.admission,
             admission_internal=self.admission_internal,
+            admission_ingest=self.admission_ingest,
+            ingest_max_frame_bytes=max(
+                self.config.ingest_max_frame_mb, 1) << 20,
             default_query_timeout=self.config.query_timeout,
             slowlog=self.slowlog,
             profile_default=self.config.profile_default)
@@ -682,6 +723,16 @@ class Server:
         self.stats.gauge("storage.containers_run", cs["run"])
         self.stats.gauge("storage.compressed_fragments",
                          cs["compressedFragments"])
+        # streaming ingest (docs/ingest.md): overlay-journal residency,
+        # unflushed backlog, and fold count — refreshed at scrape time
+        from ..storage.membudget import INGEST_DELTA_BUDGET
+        self.stats.gauge("ingest.delta_bytes",
+                         INGEST_DELTA_BUDGET.resident_bytes)
+        ing = self.committer.snapshot()
+        self.stats.gauge("ingest.delta_fragments",
+                         ing["journalFragments"])
+        self.stats.gauge("ingest.merge_backlog", ing["pendingBytes"])
+        self.stats.gauge("ingest.folds", ing["folds"])
         self.update_device_gauges()
 
     def update_device_gauges(self):
@@ -738,6 +789,9 @@ class Server:
         if hasattr(self.httpd, "close_connections"):
             self.httpd.close_connections()
         self.httpd.server_close()
+        # final group-commit flush AFTER the listener is gone (no new
+        # submissions) and BEFORE the holder closes the WAL files
+        self.committer.close()
         if self.cluster is not None:
             self.cluster.close()
         self.api.executor.close()

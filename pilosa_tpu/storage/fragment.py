@@ -56,7 +56,8 @@ from ..core import (
 from ..ops import bitset, bsi
 from ..utils.durable import checksum, durable_replace, fsync_dir, fsync_file
 from ..utils.faults import FAULTS
-from .membudget import DEFAULT_BUDGET, HOST_STAGE_BUDGET
+from . import membudget as _membudget
+from .membudget import DEFAULT_BUDGET, HOST_STAGE_BUDGET, INGEST_DELTA_BUDGET
 from .roaring_io import SnapshotFormatError, pack_snapshot, unpack_snapshot
 
 # On-disk snapshot format: see storage/roaring_io.py (pack_snapshot /
@@ -203,6 +204,21 @@ class Fragment:
         # mirrors alive (and a recreated fragment can never alias a stale
         # cache entry).
         self.gen = next(self._GEN)
+        # Ingest delta overlay (docs/ingest.md): device_gen is the gen the
+        # device-resident forms (mirrors, mesh stacks, packed streams)
+        # reflect.  Ingest flushes (ingest_apply) update the sparse store
+        # and bump gen WITHOUT invalidating device state — the new bits
+        # ride in the journal, a list of (epoch, flat word idx, word val)
+        # chunks OR'd into resident device arrays as overlays.  Any other
+        # mutation (or a fold) clears the journal and re-anchors
+        # device_gen = gen, so device consumers see exactly one of: a
+        # current form, a current-at-device_gen form plus the journal that
+        # upgrades it, or a dirty flag.
+        self.device_gen = self.gen
+        self.ingest_epoch = 0
+        self._journal: list[tuple[int, np.ndarray, np.ndarray]] = []
+        self._journal_bytes = 0
+        self._mirror_epoch: dict = {}
         # Corruption quarantine (docs/robustness.md): non-None = the
         # reason string.  Quarantined fragments answer reads as EMPTY,
         # refuse writes with FragmentQuarantinedError, and are healed
@@ -461,6 +477,8 @@ class Fragment:
         self._dirty_data = False
         self._device_dirty = True
         self.gen = next(self._GEN)  # derived caches must not serve stale
+        self.device_gen = self.gen
+        self._clear_journal()
         self._stage = None
         if self._wal_file is not None:
             try:
@@ -628,6 +646,27 @@ class Fragment:
         self._device_dirty = True
         self._dirty_data = True
         self.gen = next(self._GEN)
+        # any non-ingest mutation (or an explicit fold) supersedes the
+        # overlay journal: device forms rebuild from the sparse store,
+        # which already holds every journaled bit
+        self.device_gen = self.gen
+        self._clear_journal()
+
+    def _clear_journal(self):
+        if self._journal:
+            self._journal.clear()
+            self._journal_bytes = 0
+            INGEST_DELTA_BUDGET.unregister(("delta", id(self)))
+        self._mirror_epoch.clear()
+
+    def _fold_journal_locked(self):
+        """Merge step: device forms rebuild from the (already-current)
+        sparse store on next use.  NOT a data mutation — gen is
+        unchanged, so result caches keyed on it stay valid; only the
+        device-residency anchor moves."""
+        self._device_dirty = True
+        self.device_gen = self.gen
+        self._clear_journal()
 
     def _note_rank(self, rows):
         """Incremental rank-cache maintenance after a successful mutation
@@ -1073,6 +1112,7 @@ class Fragment:
     def _drop_stage(self):
         HOST_STAGE_BUDGET.unregister(("stage", id(self)))
         HOST_STAGE_BUDGET.unregister(("packed", id(self)))
+        INGEST_DELTA_BUDGET.unregister(("delta", id(self)))
         self._stage = None
         self._packed = None
 
@@ -1089,15 +1129,19 @@ class Fragment:
         from ..ops import containers
         with self._lock:
             p = self._packed
-            if p is not None and p[0] == self.gen:
+            if p is not None and p[0] == self.device_gen:
                 HOST_STAGE_BUDGET.touch(("packed", id(self)))
                 return p[1]
             packed = containers.pack_words(self._idx, self._val)
             # exact packed bytes supersede the census upper bound as the
-            # density-heuristic input, for free
-            self._comp_est = (self.gen, packed.nbytes)
+            # density-heuristic input, for free.  Keyed by device_gen, not
+            # gen: while an ingest journal is active the device-facing
+            # pack/estimate/signature are FROZEN at the journal's base so
+            # stack tokens stay stable between folds (packing is only
+            # requested with an empty journal, where the two gens agree).
+            self._comp_est = (self.device_gen, packed.nbytes)
             if HOST_STAGE_BUDGET.limit_bytes != 0:
-                self._packed = (self.gen, packed)
+                self._packed = (self.device_gen, packed)
                 HOST_STAGE_BUDGET.register(("packed", id(self)),
                                            packed.nbytes,
                                            self._evict_packed)
@@ -1113,10 +1157,10 @@ class Fragment:
         from ..ops import containers
         with self._lock:
             e = self._comp_est
-            if e is not None and e[0] == self.gen:
+            if e is not None and e[0] == self.device_gen:
                 return e[1]
             est = containers.estimate_packed_bytes(self._idx)
-            self._comp_est = (self.gen, est)
+            self._comp_est = (self.device_gen, est)
             return est
 
     def device_form(self) -> str:
@@ -1156,14 +1200,14 @@ class Fragment:
         from ..ops.containers import pow2_bucket
         with self._lock:
             s = self._psig
-            if s is not None and s[0] == self.gen:
+            if s is not None and s[0] == self.device_gen:
                 return s[1]
         p = self.packed_host()
         sig = ("z", self.n_rows, pow2_bucket(p.keys.size),
                pow2_bucket(p.payload.size), pow2_bucket(p.a_max),
                pow2_bucket(p.r_max))
         with self._lock:
-            self._psig = (self.gen, sig)
+            self._psig = (self.device_gen, sig)
         return sig
 
     def packed_stats(self) -> dict | None:
@@ -1172,9 +1216,86 @@ class Fragment:
         feeds metric scrapes, which must stay O(1) per fragment)."""
         with self._lock:
             p = self._packed
-            if p is None or p[0] != self.gen:
+            if p is None or p[0] != self.device_gen:
                 return None
             return p[1].type_histogram()
+
+    # -- ingest delta overlay (docs/ingest.md) -----------------------------
+
+    def ingest_apply(self, rows: np.ndarray, cols: np.ndarray) -> int:
+        """Group-commit apply of a flush's set bits for this fragment:
+        ONE sparse-store merge, ONE WAL frame, ONE generation bump, ONE
+        rank-cache touch — and, when a device-resident form exists, the
+        new words land in the overlay journal instead of invalidating it
+        (mirrors/stacks OR the journal in at next use; the sparse store
+        is the source of truth either way, so every host read is current
+        immediately).  Returns the changed-bit count; a fully idempotent
+        re-ingest (no bit changed) is a no-op — no WAL frame, no gen
+        bump — which is what makes client retries after a 503 safe."""
+        rows = np.asarray(rows, dtype=np.int64)
+        cols = np.asarray(cols, dtype=np.int64)
+        if rows.size == 0:
+            return 0
+        with self._lock:
+            self._check_writable()
+            limit = _membudget.INGEST_DELTA_LIMIT_BYTES
+            if int(rows.max()) >= self._cap_rows:
+                # capacity growth changes the device tensor shape — no
+                # overlay can cover that; _ensure_rows folds the journal
+                self._ensure_rows(int(rows.max()))
+            # Overlay only for dense-form fragments: compressed packed
+            # streams cannot absorb a scatter — those fold per flush (the
+            # flush is still one gen bump, the win over per-call
+            # bulk_import remains).  A dirty device state doesn't matter:
+            # consumers built later stage from the sparse store (already
+            # current) and record the epochs they captured.
+            overlay = limit > 0 and self.device_form() == "dense"
+            nidx, nval = _pairs_to_words(rows, cols)
+            changed = self._or_words(nidx, nval)
+            if changed == 0:
+                return 0
+            if not overlay:
+                self._mark_device_dirty()
+            else:
+                self._dirty_data = True
+                self.gen = next(self._GEN)
+                self.ingest_epoch += 1
+                self._journal.append((self.ingest_epoch, nidx, nval))
+                self._journal_bytes += int(nidx.nbytes + nval.nbytes)
+                INGEST_DELTA_BUDGET.register(
+                    ("delta", id(self)), self._journal_bytes,
+                    lambda: None)  # accounting-only; folds are cooperative
+                # per-fragment share of the delta budget: one hot
+                # fragment must not monopolise it before the committer's
+                # cross-fragment merge pass can react
+                if self._journal_bytes > max(limit // 8, 1 << 20):
+                    self._fold_journal_locked()
+            self._note_rank(rows)
+            self._log_ops(_OP_SET, rows, cols)
+            return changed
+
+    def delta_chunks(self, after_epoch: int) -> list:
+        """Journal chunks newer than ``after_epoch`` — what a device
+        consumer (mirror, mesh stack) must OR in to reach the current
+        generation.  Chunks are immutable once appended; the list copy
+        makes iteration safe outside the lock."""
+        with self._lock:
+            return [c for c in self._journal if c[0] > after_epoch]
+
+    def delta_bytes(self) -> int:
+        return self._journal_bytes
+
+    def fold_delta(self) -> bool:
+        """Fold the overlay journal into a plain device-dirty state (the
+        background-merge step): the next staging rebuilds mirrors/stacks
+        and the packed form from the sparse store, which already holds
+        every journaled bit.  Returns True if there was anything to
+        fold."""
+        with self._lock:
+            if not self._journal:
+                return False
+            self._fold_journal_locked()
+            return True
 
     def device(self, target=None):
         """The HBM-resident mirror (uploads if stale).  This is the query
@@ -1201,6 +1322,20 @@ class Fragment:
                 self._device_dirty = False
             mirror = self._mirrors.get(target)
             key = (id(self), target)
+            if mirror is not None and \
+                    self._mirror_epoch.get(target, 0) < self.ingest_epoch \
+                    and self._journal:
+                # ingest delta overlay (docs/ingest.md): OR the journal
+                # chunks this mirror hasn't seen into it ON DEVICE — a
+                # flush's worth of words travels instead of the whole
+                # dense tensor
+                from ..ingest.delta import apply_overlay, merge_chunks
+                chunks = self.delta_chunks(self._mirror_epoch.get(target, 0))
+                didx, dval = merge_chunks(chunks)
+                if didx.size:
+                    mirror = apply_overlay(mirror, didx, dval, SHARD_WORDS)
+                    self._mirrors[target] = mirror
+                self._mirror_epoch[target] = self.ingest_epoch
             if mirror is None:
                 if self.device_form() == "compressed":
                     # compressed upload: ship the packed container
@@ -1219,6 +1354,9 @@ class Fragment:
                 else:
                     mirror = jax.device_put(self.staged_dense(), target)
                 self._mirrors[target] = mirror
+                # fresh uploads stage from the sparse store, which holds
+                # every journaled bit already
+                self._mirror_epoch[target] = self.ingest_epoch
                 self.budget.register(
                     key, self._cap_rows * SHARD_WORDS * 4,
                     lambda t=target: self._evict_mirror(t))

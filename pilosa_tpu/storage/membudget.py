@@ -195,6 +195,21 @@ class DeviceBudget:
 # Process-wide default (accounting-only until a limit is configured).
 DEFAULT_BUDGET = DeviceBudget()
 
+# Ingest delta-overlay budget (docs/ingest.md): accounts the host-side
+# journals whose bits are OR'd into resident device state as overlays
+# (storage/fragment.py ingest_apply, parallel/mesh_exec.py).  This
+# instance is ACCOUNTING-ONLY (limit stays None): folding a journal must
+# take the owning fragment's lock, and running that as a register-time
+# eviction callback while ANOTHER fragment's lock is held would order
+# fragment locks against each other (deadlock).  The limit lives in
+# INGEST_DELTA_LIMIT_BYTES instead, enforced cooperatively — a fragment
+# self-folds past its per-fragment share, and the ingest committer's
+# flush loop (the only cross-fragment folder, single-threaded) folds the
+# rest when the total runs over.  ``ingest-delta-mb`` sets it; 0 disables
+# overlay journaling entirely (every flush folds immediately).
+INGEST_DELTA_BUDGET = DeviceBudget()
+INGEST_DELTA_LIMIT_BYTES = 64 << 20
+
 # Host-side dense staging cache budget (fragment.staged_dense): bounds the
 # expanded dense blocks kept around so a re-upload after HBM eviction
 # skips the sparse->dense expansion.  limit 0 = staging disabled (every

@@ -116,10 +116,13 @@ PYEOF
 # results silently, so the differential is hygiene, not a nicety.
 # Device-runtime observability (docs/observability.md "Device runtime")
 # rides too: the retrace red flag is the alarm for that same decode-bug
-# class, so its test is hygiene as well.
+# class, so its test is hygiene as well.  The streaming-ingest suite
+# (docs/ingest.md) joins them: wire-codec corruption fuzz, the
+# ingest-vs-bulk differential, group-commit counting, and the kill -9
+# commit-window harness are all acked-durability guarantees.
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' -p no:cacheprovider \
     tests/test_durability.py tests/test_crash.py tests/test_containers.py \
-    tests/test_device_obs.py
+    tests/test_device_obs.py tests/test_ingest.py
 
 # committed bytecode/cache artifacts must never land in the tree
 bad=$(git ls-files | grep -E "__pycache__|\.pyc$" || true)

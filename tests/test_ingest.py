@@ -1,0 +1,579 @@
+"""Streaming ingest subsystem (docs/ingest.md): wire codec fuzz,
+ingest-vs-bulk_import differential (overlay-live AND merged), group
+commit counting, backpressure 503s, 2-node forwarded-shard ingest, the
+CLI client, and the kill -9 crash window inside the committer flush."""
+
+import http.client
+import io
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import SHARD_WIDTH
+from pilosa_tpu.ingest import wire
+from pilosa_tpu.ingest.committer import GroupCommitter
+from pilosa_tpu.storage import Holder
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- helpers ---------------------------------------------------------------
+
+
+def _req(port, method, path, body=None, ctype="application/json",
+         timeout=120):
+    r = urllib.request.Request(f"http://localhost:{port}{path}",
+                               method=method, data=body)
+    if body is not None:
+        r.add_header("Content-Type", ctype)
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _mk_server(tmp_path, **overrides):
+    from pilosa_tpu.server.server import Config, Server
+    overrides.setdefault("ingest_flush_ms", 20.0)
+    cfg = Config(data_dir=str(tmp_path / "ing_node"), bind="localhost:0",
+                 anti_entropy_interval=0, **overrides)
+    srv = Server(cfg)
+    srv.open()
+    return srv
+
+
+@pytest.fixture
+def srv(tmp_path):
+    s = _mk_server(tmp_path)
+    yield s
+    s.close()
+
+
+def _wal_frames(frag) -> int:
+    """Count CRC frames in a fragment's WAL file."""
+    from pilosa_tpu.storage.fragment import _WAL_FRAME, _WAL_MAGIC
+    with open(frag._wal_path(), "rb") as f:
+        buf = f.read()
+    assert buf.startswith(_WAL_MAGIC)
+    off, n = len(_WAL_MAGIC), 0
+    while off < len(buf):
+        plen, _crc = _WAL_FRAME.unpack_from(buf, off)
+        off += _WAL_FRAME.size + plen
+        n += 1
+    assert off == len(buf)
+    return n
+
+
+# -- wire codec ------------------------------------------------------------
+
+
+def test_wire_round_trip(rng):
+    rows = rng.integers(0, 50, 1000)
+    cols = rng.integers(0, 5 * SHARD_WIDTH, 1000)
+    ts = rng.integers(0, 2 ** 31, 1000)
+    for t in (None, ts):
+        body = wire.encode_records(rows, cols, ts=t, frame_records=300)
+        reader = wire.FrameReader(io.BytesIO(body).read, len(body))
+        out_r, out_c, out_t = [], [], []
+        frames = 0
+        while True:
+            item = reader.next_frame()
+            if item is None:
+                break
+            rectype, recs, _n = item
+            frames += 1
+            assert rectype == (wire.REC_BITS if t is None
+                               else wire.REC_BITS_TS)
+            out_r.append(recs["row"])
+            out_c.append(recs["col"])
+            if t is not None:
+                out_t.append(recs["ts"])
+        assert frames == 4  # 1000 records / 300 per frame
+        assert np.array_equal(np.concatenate(out_r), rows)
+        assert np.array_equal(np.concatenate(out_c), cols)
+        if t is not None:
+            assert np.array_equal(np.concatenate(out_t), ts)
+    # values records
+    vals = rng.integers(-1000, 1000, 64)
+    body = wire.encode_records(None, cols[:64], values=vals)
+    reader = wire.FrameReader(io.BytesIO(body).read, len(body))
+    rectype, recs, _n = reader.next_frame()
+    assert rectype == wire.REC_VALS
+    assert np.array_equal(recs["value"], vals)
+    assert reader.next_frame() is None
+
+
+def _drain(body: bytes):
+    reader = wire.FrameReader(io.BytesIO(body).read, len(body))
+    out = []
+    while True:
+        item = reader.next_frame()
+        if item is None:
+            return out
+        out.append((item[0], item[1].tobytes()))
+
+
+def test_wire_every_byte_corruption_rejected(rng):
+    """Flip one bit at EVERY byte offset of a two-frame stream: the
+    reader must reject the stream (magic check, frame bounds, CRC) —
+    never silently import different records."""
+    rows = rng.integers(0, 8, 40)
+    cols = rng.integers(0, SHARD_WIDTH, 40)
+    body = wire.encode_records(rows, cols, frame_records=25)
+    want = _drain(body)
+    for off in range(len(body)):
+        bad = bytearray(body)
+        bad[off] ^= 0x10
+        try:
+            got = _drain(bytes(bad))
+        except wire.FrameError:
+            continue
+        assert got != want, f"corruption at byte {off} went undetected"
+    # truncation at every length is detected too
+    for cut in range(len(body)):
+        try:
+            got = _drain(body[:cut])
+        except wire.FrameError:
+            continue
+        assert got != want, f"truncation to {cut} bytes went undetected"
+
+
+def test_wire_frame_ceiling():
+    payload = wire.pack_bits([1], [2])
+    body = wire.MAGIC + wire.encode_frame(payload)
+    reader = wire.FrameReader(io.BytesIO(body).read, len(body),
+                              max_frame_bytes=4)
+    with pytest.raises(wire.FrameError, match="ingest-max-frame-mb"):
+        reader.next_frame()
+
+
+# -- differential: ingest vs bulk_import -----------------------------------
+
+
+def test_ingest_bulk_differential(rng):
+    """The same corpus through the committer and through bulk_import
+    yields byte-identical fragments; queries agree while deltas are
+    overlay-resident AND after the merge folds them."""
+    from pilosa_tpu.executor import Executor
+
+    n_shards = 4
+    batches = []
+    for _ in range(6):
+        n = int(rng.integers(200, 2000))
+        batches.append((rng.integers(0, 24, n),
+                        rng.integers(0, n_shards * SHARD_WIDTH, n)))
+
+    h_bulk = Holder(None)
+    idx_b = h_bulk.create_index("d")
+    f_b = idx_b.create_field("f")
+    for rows, cols in batches:
+        f_b.import_bits(rows, cols)
+        idx_b.add_existence(np.unique(cols))
+
+    h_ing = Holder(None)
+    idx_i = h_ing.create_index("d")
+    idx_i.create_field("f")
+    com = GroupCommitter(h_ing, flush_ms=0)  # inline flush per wait
+    ex = Executor(h_ing, use_mesh=True)
+    ex_b = Executor(h_bulk, use_mesh=True)
+    queries = ["Count(Row(f=3))", "TopN(f, n=5)",
+               "Count(Intersect(Row(f=1), Row(f=2)))"]
+    try:
+        # prime the mesh stacks so later flushes exercise the overlay
+        seq = com.submit("d", "f", rows=batches[0][0], cols=batches[0][1])
+        com.wait_flushed(seq)
+        for q in queries:
+            ex.execute("d", q)
+        for rows, cols in batches[1:]:
+            seq = com.submit("d", "f", rows=rows, cols=cols)
+            com.wait_flushed(seq)
+        live_journal = sum(
+            fr.delta_bytes() for *_x, fr in h_ing.iter_fragments("d"))
+        assert live_journal > 0, "overlay journal never engaged"
+        for q in queries:  # overlay-resident reads
+            assert repr(ex.execute("d", q)) == repr(ex_b.execute("d", q))
+        com.merge_all()  # fold = the background merge
+        assert sum(fr.delta_bytes()
+                   for *_x, fr in h_ing.iter_fragments("d")) == 0
+        for q in queries:  # merged reads
+            assert repr(ex.execute("d", q)) == repr(ex_b.execute("d", q))
+        # byte-identical fragments (snapshot codec over the host store)
+        frs_b = {(f_, v, s): fr for _i, f_, v, s, fr
+                 in h_bulk.iter_fragments("d")}
+        frs_i = {(f_, v, s): fr for _i, f_, v, s, fr
+                 in h_ing.iter_fragments("d")}
+        assert set(frs_b) == set(frs_i)
+        for key, fr in frs_b.items():
+            assert fr.snapshot_bytes() == frs_i[key].snapshot_bytes(), key
+    finally:
+        ex.close()
+        ex_b.close()
+        com.close()
+
+
+def test_ingest_int_values(srv, rng):
+    p = srv.port
+    _req(p, "POST", "/index/i", b"{}")
+    _req(p, "POST", "/index/i/field/v",
+         json.dumps({"options": {"type": "int", "min": -500,
+                                 "max": 500}}).encode())
+    cols = np.arange(300) * 17 % (2 * SHARD_WIDTH)
+    vals = rng.integers(-500, 500, 300)
+    body = wire.encode_records(None, cols, values=vals)
+    out = _req(p, "POST", "/index/i/field/v/ingest", body,
+               "application/octet-stream")
+    assert out["records"] == 300
+    res = _req(p, "POST", "/index/i/query", b"Sum(field=v)")
+    last = {}
+    for c, v in zip(cols, vals):
+        last[int(c)] = int(v)
+    assert res["results"][0]["value"] == sum(last.values())
+
+
+def test_ingest_rejects_bad_records(srv, rng):
+    """Record validation happens AT THE SOCKET (400), never as a
+    poisoned shared flush: negative ids and rectype/field-type
+    mismatches are refused before submission."""
+    p = srv.port
+    _req(p, "POST", "/index/val", b"{}")
+    _req(p, "POST", "/index/val/field/f", b"{}")
+    _req(p, "POST", "/index/val/field/v",
+         json.dumps({"options": {"type": "int", "min": 0,
+                                 "max": 100}}).encode())
+    cases = [
+        # negative row into a set field
+        ("f", wire.encode_records([-1], [5])),
+        # negative column
+        ("f", wire.encode_records([1], [-5])),
+        # values frame at a set field
+        ("f", wire.encode_records(None, [5], values=[7])),
+        # bits frame at an int field
+        ("v", wire.encode_records([1], [5])),
+    ]
+    for field, body in cases:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(p, "POST", f"/index/val/field/{field}/ingest", body,
+                 "application/octet-stream")
+        ei.value.read()
+        assert ei.value.code == 400
+    # the server stayed consistent: a valid stream still lands
+    out = _req(p, "POST", "/index/val/field/f/ingest",
+               wire.encode_records([1], [5]),
+               "application/octet-stream")
+    assert out["records"] == 1
+
+
+def test_inline_flush_concurrent_ack_serialized(rng):
+    """flush_ms <= 0 (inline) mode under concurrent producers: every
+    acked wait_flushed means the records are actually applied — the
+    flush lock keeps a second caller from advancing the covering
+    sequence past an in-flight apply."""
+    import threading
+
+    h = Holder(None)
+    idx = h.create_index("inl", track_existence=False)
+    f = idx.create_field("f")
+    com = GroupCommitter(h, flush_ms=0)
+    errs = []
+
+    def producer(k):
+        try:
+            for i in range(20):
+                rows = np.full(50, k, dtype=np.int64)
+                cols = (np.arange(50) + i * 50) % SHARD_WIDTH
+                seq = com.submit("inl", "f", rows=rows, cols=cols)
+                assert com.wait_flushed(seq)
+                # acked => visible in the host store immediately
+                got = set(f.view("standard").fragment(0)
+                          .rows_with_bit(int(cols[0])))
+                assert k in got, f"acked write for row {k} not applied"
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=producer, args=(k,)) for k in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    com.close()
+    assert not errs, errs
+
+
+# -- group commit ----------------------------------------------------------
+
+
+def test_group_commit_one_frame_one_gen(tmp_path, rng):
+    """5000 records over many wire frames in one request: each touched
+    fragment gets ONE WAL frame and ONE generation bump.  The flush
+    window is set wide so the whole request coalesces into one flush
+    deterministically (the acker's wait nudges it at end-of-stream)."""
+    s = _mk_server(tmp_path, ingest_flush_ms=1000.0)
+    try:
+        p = s.port
+        _req(p, "POST", "/index/g", b"{}")
+        _req(p, "POST", "/index/g/field/f", b"{}")
+        # first stream creates the fragments
+        rows = rng.integers(0, 8, 500)
+        cols = rng.integers(0, SHARD_WIDTH // 2, 500)
+        _req(p, "POST", "/index/g/field/f/ingest",
+             wire.encode_records(rows, cols), "application/octet-stream")
+        frag = s.holder.fragment("g", "f", "standard", 0)
+        gen0 = frag.gen
+        epoch0 = frag.ingest_epoch
+        frames0 = _wal_frames(frag)
+        # 5000 records, 10 wire frames, one request -> one flush
+        rows = rng.integers(0, 8, 5000)
+        cols = rng.integers(SHARD_WIDTH // 2, SHARD_WIDTH, 5000)
+        out = _req(p, "POST", "/index/g/field/f/ingest",
+                   wire.encode_records(rows, cols, frame_records=500),
+                   "application/octet-stream")
+        assert out["frames"] == 10 and out["records"] == 5000
+        # gen moved (readers/result caches must invalidate) and it moved
+        # ONCE for this fragment: exactly one journal chunk / one epoch
+        # (Fragment._GEN is process-global, so gen0+1 would race other
+        # fragments — the per-fragment epoch is the bump counter)
+        assert frag.gen != gen0
+        assert frag.ingest_epoch == epoch0 + 1, \
+            "expected exactly one gen bump / journal chunk per flush"
+        assert _wal_frames(frag) == frames0 + 1, \
+            "expected exactly one WAL frame per flush"
+        assert s.committer.snapshot()["flushes"] == 2
+    finally:
+        s.close()
+
+
+def test_idempotent_reingest_no_wal_growth(srv, rng):
+    p = srv.port
+    _req(p, "POST", "/index/r", b"{}")
+    _req(p, "POST", "/index/r/field/f", b"{}")
+    rows = rng.integers(0, 8, 400)
+    cols = rng.integers(0, SHARD_WIDTH, 400)
+    body = wire.encode_records(rows, cols)
+    _req(p, "POST", "/index/r/field/f/ingest", body,
+         "application/octet-stream")
+    frag = srv.holder.fragment("r", "f", "standard", 0)
+    gen0, frames0 = frag.gen, _wal_frames(frag)
+    # exact resend (the retry-after-503 story): no change, no WAL frame
+    _req(p, "POST", "/index/r/field/f/ingest", body,
+         "application/octet-stream")
+    assert frag.gen == gen0
+    assert _wal_frames(frag) == frames0
+
+
+# -- backpressure ----------------------------------------------------------
+
+
+def test_backpressure_503_burst(tmp_path, rng):
+    """A stalled flush (failpoint delay) with a tiny high-water mark
+    turns sustained ingest into 503 + Retry-After; after the stall
+    clears, the idempotent resend succeeds and the data is complete."""
+    from pilosa_tpu.utils.faults import FAULTS
+
+    s = _mk_server(tmp_path, ingest_flush_ms=30.0)
+    try:
+        p = s.port
+        _req(p, "POST", "/index/b", b"{}")
+        _req(p, "POST", "/index/b/field/f", b"{}")
+        s.committer.HIGH_WATER_BYTES = 2048
+        FAULTS.arm("ingest.flush", mode="delay", arg=1.5)
+        rows = rng.integers(0, 8, 3000)
+        cols = rng.integers(0, SHARD_WIDTH, 3000)
+        body = wire.encode_records(rows, cols, frame_records=200)
+        got_503 = False
+        try:
+            _req(p, "POST", "/index/b/field/f/ingest", body,
+                 "application/octet-stream")
+        except urllib.error.HTTPError as e:
+            got_503 = e.code == 503
+            assert e.headers.get("Retry-After") is not None
+            e.read()
+        assert got_503, "backlog over high-water never produced a 503"
+        FAULTS.disarm("ingest.flush")
+        s.committer.HIGH_WATER_BYTES = GroupCommitter.HIGH_WATER_BYTES
+        out = _req(p, "POST", "/index/b/field/f/ingest", body,
+                   "application/octet-stream")
+        assert out["records"] == 3000
+        res = _req(p, "POST", "/index/b/query", b"Count(Row(f=3))")
+        want = len({int(c) for r, c in zip(rows, cols) if r == 3})
+        assert res["results"][0] == want
+    finally:
+        FAULTS.disarm()
+        s.close()
+
+
+# -- cluster: forwarded-shard ingest ---------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_two_node_forwarded_ingest(tmp_path, rng):
+    from pilosa_tpu.server.server import Config, Server
+
+    ports = [_free_port(), _free_port()]
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = []
+    for i in range(2):
+        cfg = Config(data_dir=str(tmp_path / f"n{i}"), bind=hosts[i],
+                     node_id=f"node{i}", cluster_hosts=hosts,
+                     replica_n=1, anti_entropy_interval=0,
+                     ingest_flush_ms=20.0)
+        s = Server(cfg)
+        s.open()
+        servers.append(s)
+    try:
+        p = ports[0]
+        _req(p, "POST", "/index/c", b"{}")
+        _req(p, "POST", "/index/c/field/f", b"{}")
+        n_shards = 6
+        rows = rng.integers(0, 16, 4000)
+        cols = rng.integers(0, n_shards * SHARD_WIDTH, 4000)
+        out = _req(p, "POST", "/index/c/field/f/ingest",
+                   wire.encode_records(rows, cols, frame_records=700),
+                   "application/octet-stream")
+        assert out["forwarded"] > 0, \
+            "no shard landed on the remote node (placement fluke?)"
+        # every shard's bits live on its OWNER, nowhere else
+        for shard in np.unique(cols // SHARD_WIDTH):
+            owner = servers[0].cluster.placement.primary("c", int(shard))
+            for s in servers:
+                frag = s.holder.fragment("c", "f", "standard", int(shard))
+                if s.cluster.node_id == owner:
+                    assert frag is not None and frag.host_bytes() > 0
+                elif frag is not None:
+                    assert frag.host_bytes() == 0
+        # coordinator-side query agrees with a host oracle
+        for row in (3, 7):
+            want = len({int(c) for r, c in zip(rows, cols) if r == row})
+            res = _req(p, "POST", "/index/c/query",
+                       f"Count(Row(f={row}))".encode())
+            assert res["results"][0] == want
+    finally:
+        for s in servers:
+            s.close()
+
+
+# -- CLI client ------------------------------------------------------------
+
+
+def test_cli_ingest_csv(srv, tmp_path, rng):
+    from pilosa_tpu.cli import main
+
+    rows = rng.integers(0, 8, 1500)
+    cols = rng.integers(0, SHARD_WIDTH, 1500)
+    csv = tmp_path / "in.csv"
+    csv.write_text("".join(f"{r},{c}\n" for r, c in zip(rows, cols)))
+    assert main(["ingest", "-host", f"localhost:{srv.port}",
+                 "-i", "cli", "-f", "f", "--create",
+                 "--batch-size", "400", str(csv)]) == 0
+    res = _req(srv.port, "POST", "/index/cli/query", b"Count(Row(f=5))")
+    want = len({int(c) for r, c in zip(rows, cols) if r == 5})
+    assert res["results"][0] == want
+
+
+# -- roaring octet-stream satellite ----------------------------------------
+
+
+def test_import_roaring_binary_and_sniff(srv, rng):
+    from pilosa_tpu.storage.roaring_io import pack_roaring
+
+    p = srv.port
+    _req(p, "POST", "/index/ro", b"{}")
+    _req(p, "POST", "/index/ro/field/f", b"{}")
+    rows = np.sort(rng.integers(0, 8, 300))
+    cols = rng.integers(0, SHARD_WIDTH, 300)
+    blob = pack_roaring(rows, cols)
+    # raw octet-stream body
+    _req(p, "POST", "/index/ro/field/f/import-roaring/0", blob,
+         "application/octet-stream")
+    want = len({int(c) for r, c in zip(rows, cols) if r == 2})
+    res = _req(p, "POST", "/index/ro/query", b"Count(Row(f=2))")
+    assert res["results"][0] == want
+    # lying JSON Content-Type over raw bytes: sniffed as binary
+    _req(p, "POST", "/index/ro/field/f/import-roaring/1", blob,
+         "application/json")
+    res = _req(p, "POST", "/index/ro/query",
+               b"Count(Row(f=2))")
+    assert res["results"][0] == 2 * want
+
+
+# -- kill -9 inside the committer flush ------------------------------------
+
+
+def _start_worker(data_dir, spec=""):
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                  if p])
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "crash_worker.py"),
+         str(data_dir), f"localhost:{port}", "100000", spec],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        cwd=REPO, env=env)
+    line = proc.stdout.readline().decode()
+    assert "READY" in line, line
+    return proc, port
+
+
+def test_kill9_in_commit_window_zero_acked_loss(tmp_path, rng):
+    """SIGKILL the server inside the committer flush (after the WAL
+    appends, before ackers release — the worst window for an acker):
+    every ACKED ingest batch must survive the restart byte-for-byte."""
+    data_dir = tmp_path / "crash"
+    # skip 2 flushes, die on the 3rd flush's ack window
+    proc, port = _start_worker(data_dir, "ingest.flush.ack=kill:2")
+    acked: list[tuple[np.ndarray, np.ndarray]] = []
+    try:
+        _req(port, "POST", "/index/k", b"{}")
+        _req(port, "POST", "/index/k/field/f", b"{}")
+        for i in range(40):
+            rows = rng.integers(0, 6, 150)
+            cols = rng.integers(0, SHARD_WIDTH, 150)
+            body = wire.encode_records(rows, cols)
+            try:
+                _req(port, "POST", "/index/k/field/f/ingest", body,
+                     "application/octet-stream", timeout=20)
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    http.client.HTTPException):
+                break  # the kill landed
+            acked.append((rows, cols))
+        else:
+            pytest.fail("worker never died at the armed kill window")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=30)
+    assert acked, "no batch was acked before the kill"
+    # restart clean and verify every acked bit
+    proc, port = _start_worker(data_dir, "")
+    try:
+        want_rows: dict[int, set] = {}
+        for rows, cols in acked:
+            for r, c in zip(rows, cols):
+                want_rows.setdefault(int(r), set()).add(int(c))
+        for row, want_cols in want_rows.items():
+            res = _req(port, "POST", "/index/k/query",
+                       f"Row(f={row})".encode())
+            got = set(res["results"][0]["columns"])
+            missing = want_cols - got
+            assert not missing, \
+                f"row {row}: {len(missing)} acked bits lost"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
