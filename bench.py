@@ -733,6 +733,8 @@ def bench_config5_distributed(rng):
         for s in servers:
             try:
                 s.close()
+            # lint: allow(swallowed-exception) — bench teardown; the
+            # server may already be down and the leg's numbers are in
             except Exception:
                 pass
 
@@ -1780,6 +1782,8 @@ def main():
         srv.open()
         http_qps = bench_http(srv.port, rng, meta["star_rows"])
         srv.httpd.shutdown()
+    # lint: allow(swallowed-exception) — the HTTP leg is optional; a
+    # null qps in the emitted report IS the failure signal
     except Exception:
         http_qps = None
 

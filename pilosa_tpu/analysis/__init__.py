@@ -1,0 +1,23 @@
+"""Project invariant analyzer (docs/static-analysis.md).
+
+Two halves:
+
+* ``astlint`` — a scope-aware AST lint suite encoding the invariants
+  this repo used to enforce with review conventions and check.sh greps
+  (traced-closure capture, wall-clock timing, exception swallows,
+  batcher bypass, cross-thread context discipline, metrics/failpoint
+  catalogs).  Run as ``python -m pilosa_tpu.analysis`` from the repo
+  root, or ``pilosa-tpu analyze``; exits non-zero on any finding.
+
+* ``lockcheck`` — a runtime lock-order race detector: instrumented
+  Lock/RLock/Condition (adopted tree-wide via utils/locks.py) that
+  records per-thread held-lock stacks, builds the global acquisition-
+  order graph over named lock classes, and reports order-inversion
+  cycles and undeclared same-class nesting at process exit and at
+  /debug/locks.  Armed with ``PILOSA_TPU_LOCKCHECK=1`` (``=strict``
+  additionally fails the process on violations).
+
+This package deliberately imports nothing heavyweight at package level:
+``utils/locks.py`` pulls ``lockcheck`` on every armed process start, and
+the lint suite must stay runnable on a box without jax.
+"""

@@ -1,0 +1,5 @@
+import sys
+
+from .astlint import main
+
+sys.exit(main())

@@ -1,0 +1,12 @@
+"""Rule modules — importing this package registers every rule with the
+astlint registry (one module per rule, docs/static-analysis.md)."""
+
+from . import (  # noqa: F401
+    batcher_bypass,
+    except_swallow,
+    failpoints,
+    metrics_docs,
+    thread_context,
+    traced_closure,
+    wallclock,
+)

@@ -1,0 +1,94 @@
+"""traced-closure: loop-carried/reassigned locals read inside traced
+closures (the PR 7 silent-retrace bug class).
+
+jax executes a traced function's python body at TRACE time only.  A
+cached executable that later re-traces (new shape bucket, new stacked
+group size) re-reads its closure CELLS — which a later loop iteration
+may have rebound to another group's values.  The PR 7 bug was exactly
+this: a re-traced segments executable read ``layout`` rebound to the
+NEXT group's container buckets and silently dropped every run container
+(guarded until now only by the comment at parallel/mesh_exec.py:979).
+
+The rule: inside any function decorated by / passed to ``jax.jit``,
+``vmap``, ``pmap``, ``shard_map`` (or this repo's ``_jit_shard_map`` /
+``_InstrumentedExec`` wrappers), a read of an enclosing FUNCTION scope
+name that is loop-carried or reassigned must instead be frozen as a
+keyword default (``_layout=layout``).  Single-assignment enclosing
+locals and module globals are safe — the cell can never change under a
+re-trace.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astlint import rule
+
+TRACE_WRAPPERS = {
+    "jit", "vmap", "pmap", "shard_map", "_shard_map", "_jit_shard_map",
+    "_InstrumentedExec", "eval_shape", "make_jaxpr",
+}
+
+
+def _callable_name(node) -> str | None:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _mentions_wrapper(node) -> bool:
+    return any(_callable_name(n) in TRACE_WRAPPERS
+               for n in ast.walk(node)
+               if isinstance(n, (ast.Name, ast.Attribute)))
+
+
+def _traced_scopes(mod):
+    """Function scopes whose bodies jax traces: decorated defs plus
+    functions/lambdas passed (directly or by name) to a wrapper call."""
+    scopes = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_mentions_wrapper(d) for d in node.decorator_list):
+                scopes.add(node._ptpu_fscope)
+        elif isinstance(node, ast.Call):
+            if _callable_name(node.func) not in TRACE_WRAPPERS:
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            for a in args:
+                if isinstance(a, ast.Lambda):
+                    scopes.add(a._ptpu_fscope)
+                elif isinstance(a, ast.Name):
+                    target = node._ptpu_scope.lookup_func(a.id)
+                    if target is not None:
+                        scopes.add(target)
+    return scopes
+
+
+@rule("traced-closure", scope="src")
+def check(mod):
+    """Traced closure reads an enclosing loop-carried/reassigned local
+    (freeze it as a keyword default)."""
+    mod.scopes  # annotate nodes with scope backlinks before walking
+    seen = set()
+    for fscope in _traced_scopes(mod):
+        for name, line in fscope.free_reads():
+            if (name, line) in seen:
+                continue
+            anc = fscope.enclosing_function()
+            while anc is not None:
+                if name in anc.globals_:
+                    break
+                if name in anc.bound:
+                    loopy = name in anc.loop_bound
+                    if loopy or anc.bind_count.get(name, 0) >= 2:
+                        seen.add((name, line))
+                        how = "loop-carried" if loopy else "reassigned"
+                        yield line, (
+                            f"traced closure reads {how} enclosing local "
+                            f"'{name}'; a re-trace reads the rebound cell "
+                            f"— freeze it as a keyword default "
+                            f"(_{name}={name})")
+                    break
+                anc = anc.enclosing_function()

@@ -7,7 +7,6 @@ nothing in the server package touches holder/executor directly.
 
 from __future__ import annotations
 
-import threading
 from typing import Any
 
 import numpy as np
@@ -16,6 +15,7 @@ from . import __version__
 from .core import SHARD_WIDTH
 from .executor import Executor
 from .storage import FieldOptions, Holder
+from .utils.locks import make_rlock
 from .utils.stats import StatsClient
 
 # Cluster states (cluster.go:47-50).
@@ -73,7 +73,7 @@ class API:
             dispatch_batch=dispatch_batch,
             dispatch_batch_max=dispatch_batch_max,
             dispatch_batch_window_us=dispatch_batch_window_us)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("api-schema")
 
     # -- state validation (api.go:119) -------------------------------------
 

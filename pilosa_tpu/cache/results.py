@@ -25,10 +25,11 @@ the stale entry eagerly, so churned queries don't pool garbage.
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 
 import numpy as np
+
+from ..utils.locks import make_lock
 
 
 # -- generation vectors ------------------------------------------------------
@@ -121,7 +122,7 @@ class ResultCache:
     def __init__(self, limit_bytes: int = 0, stats=None):
         self.limit_bytes = limit_bytes
         self.stats = stats
-        self._lock = threading.Lock()
+        self._lock = make_lock("result-cache")
         self._entries: OrderedDict = OrderedDict()  # key -> (results, nbytes)
         self._by_query: dict = {}  # qkey -> full key (stale-entry sweep)
         self.resident_bytes = 0

@@ -315,6 +315,20 @@ def cmd_check(args) -> int:
     return 0 if ok else 1
 
 
+def cmd_analyze(args) -> int:
+    """Run the project invariant analyzer over a checkout
+    (docs/static-analysis.md) — the same suite scripts/check.sh and CI
+    run: AST lint rules plus the cross-file metric/failpoint catalogs.
+    Exits non-zero on any finding."""
+    from .analysis.astlint import main as analysis_main
+    argv = ["--root", args.root]
+    for r in args.rule or []:
+        argv += ["--rule", r]
+    if args.list_rules:
+        argv.append("--list-rules")
+    return analysis_main(argv)
+
+
 def cmd_inspect(args) -> int:
     """Fragment stats (ctl/inspect.go:30-110)."""
     import numpy as np
@@ -586,6 +600,16 @@ def main(argv=None) -> int:
     sp = sub.add_parser("inspect", help="inspect fragment file stats")
     sp.add_argument("files", nargs="+")
     sp.set_defaults(fn=cmd_inspect)
+
+    sp = sub.add_parser("analyze",
+                        help="run the project invariant analyzer "
+                             "(AST lint suite) over a checkout")
+    sp.add_argument("--root", default=".",
+                    help="repo checkout to analyze (default: cwd)")
+    sp.add_argument("--rule", action="append", default=None,
+                    help="run only this rule id (repeatable)")
+    sp.add_argument("--list-rules", action="store_true")
+    sp.set_defaults(fn=cmd_analyze)
 
     sp = sub.add_parser("top", help="live terminal summary of a node")
     sp.add_argument("-host", default="localhost:10101")

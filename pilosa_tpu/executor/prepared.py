@@ -39,7 +39,6 @@ parser.
 from __future__ import annotations
 
 import re
-import threading
 from collections import OrderedDict
 
 import numpy as np
@@ -48,6 +47,7 @@ from ..core import schema_epoch
 from ..native import fingerprint_native
 from ..pql import parse
 from ..pql.ast import LitInt, Query
+from ..utils.locks import make_lock
 from .plan import Resolver, parametrize
 
 # Integer literals only: quoted strings and bare timestamps pass through
@@ -220,7 +220,7 @@ class PreparedCache:
     def __init__(self, executor, max_entries: int = 256):
         self.executor = executor
         self.max_entries = max_entries
-        self._lock = threading.Lock()
+        self._lock = make_lock("prepared")
         self._entries: OrderedDict = OrderedDict()
         # observability (surfaced at /debug/vars via utils.stats)
         self.hits = 0

@@ -38,6 +38,7 @@ import numpy as np
 from ..core import SHARD_WIDTH, VIEW_STANDARD
 from ..storage import membudget as _membudget
 from ..utils.faults import FAULTS
+from ..utils.locks import make_condition, make_lock
 
 
 class _Pending:
@@ -78,13 +79,13 @@ class GroupCommitter:
             self.FLUSH_RECORDS = flush_records
         if high_water_bytes is not None:
             self.HIGH_WATER_BYTES = high_water_bytes
-        self._cond = threading.Condition(threading.Lock())
+        self._cond = make_condition("committer")
         # Serializes whole flushes (take -> apply -> ack).  Without it,
         # two inline-mode (flush_ms <= 0) callers could interleave: the
         # second takes an EMPTY pending set stamped with the first's
         # covering sequence and advances _flushed_seq before the first
         # has written its WAL frames — acking undurable data.
-        self._flush_lock = threading.Lock()
+        self._flush_lock = make_lock("committer-flush")
         self._pend: dict[tuple[str, str], _Pending] = {}
         self._pend_bytes = 0
         self._pend_records = 0
@@ -210,8 +211,11 @@ class GroupCommitter:
                     self._cond.wait(self.flush_ms / 1e3)
             try:
                 self._flush_once()
+            # lint: allow(swallowed-exception) — per-flush errors are
+            # recorded per covering sequence inside _flush_once and
+            # re-raised to every waiter in its submission range
             except Exception:
-                pass  # per-flush errors are recorded for waiters
+                pass
 
     def _take_pending(self):
         with self._cond:

@@ -43,6 +43,9 @@ def _build_and_load(name: str):
             check=True, capture_output=True, timeout=60)
         os.replace(tmp, so)
         return ctypes.CDLL(so)
+    # lint: allow(swallowed-exception) — the native extension is an
+    # optional accelerator: no cc / no toolchain falls back to the pure-
+    # python path, and callers treat None as exactly that
     except Exception:
         return None
 

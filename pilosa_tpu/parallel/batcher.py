@@ -54,6 +54,7 @@ from ..utils import devobs
 from ..utils import profile as qprof
 from ..utils.deadline import DeadlineExceeded, activate, current
 from ..utils.faults import FAULTS
+from ..utils.locks import make_condition
 from ..utils.stats import BucketHistogram, NopStatsClient, ReservoirTimer
 from ..utils.tracing import GLOBAL_TRACER
 from .mesh_exec import _DISPATCH_LOCK
@@ -109,7 +110,7 @@ class DispatchBatcher:
         self.max_batch = max(int(max_batch), 1)
         self.window_s = max(float(window_us), 0.0) / 1e6
         self.stats = stats if stats is not None else NopStatsClient()
-        self._cond = threading.Condition()
+        self._cond = make_condition("batcher", rlock=True)
         self._queue: list[_Ticket] = []
         self._thread: threading.Thread | None = None
         self._tid: int | None = None

@@ -54,6 +54,7 @@ from ..utils import degraded
 from ..utils import profile as qprof
 from ..utils.deadline import DEADLINE_HEADER, current as current_ctx
 from ..utils.faults import FAULTS
+from ..utils.locks import make_lock, make_rlock
 from ..utils.tracing import GLOBAL_TRACER, PROBE_HEADER, TRACE_HEADER
 from .placement import Placement
 
@@ -205,9 +206,9 @@ class InternalClient:
         # every pooled connection also registers here so close() can
         # release sockets owned by other threads' pools
         self._all_conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = make_lock("client-conns")
         self._breakers: dict[str, _Breaker] = {}
-        self._breaker_lock = threading.Lock()
+        self._breaker_lock = make_lock("breaker")
         # per-host pool generation (see note_recovered); conns stamp the
         # generation at creation and are lazily discarded on mismatch
         self._host_gen: dict[str, int] = {}
@@ -311,6 +312,8 @@ class InternalClient:
         for c in conns:
             try:
                 c.close()
+            # lint: allow(swallowed-exception) — client shutdown: the
+            # socket may already be dead, and there is nothing to do
             except Exception:
                 pass
 
@@ -461,6 +464,8 @@ class InternalClient:
         if status >= 400:
             try:
                 msg = json.loads(data).get("error", data.decode())
+            # lint: allow(swallowed-exception) — error-body decode
+            # fallback; the ClusterError below carries the raw body
             except Exception:
                 msg = data.decode(errors="replace")
             raise ClusterError(f"{host} {path}: {status} {msg}")
@@ -566,6 +571,8 @@ class InternalClient:
         if status >= 400:
             try:
                 msg = json.loads(data).get("error", data.decode())
+            # lint: allow(swallowed-exception) — error-body decode
+            # fallback; the ClusterError below carries the raw body
             except Exception:
                 msg = data.decode(errors="replace")
             raise ClusterError(f"{host} ingest: {status} {msg}")
@@ -584,6 +591,8 @@ class InternalClient:
         if status >= 400:
             try:
                 msg = json.loads(resp).get("error", resp.decode())
+            # lint: allow(swallowed-exception) — error-body decode
+            # fallback; the ClusterError below carries the raw body
             except Exception:
                 msg = resp.decode(errors="replace")
             raise ClusterError(
@@ -685,7 +694,7 @@ class RemoteTranslateStore:
         self._k2i: dict[str, int] = {}
         self._i2k: dict[int, str] = {}
         self._sync_after = 0  # contiguous replication watermark
-        self._lock = threading.RLock()
+        self._lock = make_rlock("remote-translate")
 
     def _path(self) -> str:
         p = f"/internal/translate/{self.index}"
@@ -844,7 +853,7 @@ class Cluster:
         self.health_interval = health_interval
         self._closing = threading.Event()
         self._health_thread = None
-        self._resize_lock = threading.Lock()
+        self._resize_lock = make_lock("resize-job")
         # membership epoch: bumped by every completed resize, persisted in
         # .topology, carried on resize-complete messages so retries are
         # idempotent and stale nodes are detectable by probe
@@ -868,7 +877,7 @@ class Cluster:
         # another lock) guards every access instead of leaning on GIL
         # atomicity of single set ops (r5 advisor).
         self._remote_shards: dict[str, set[int]] = {}
-        self._shards_lock = threading.Lock()
+        self._shards_lock = make_lock("cluster-shards")
         # Per-(index, peer) data-version registry for the coordinator-
         # scope result cache (cache/results.py): bumped whenever this
         # node forwards a write/import/repair to the peer, and whenever a
@@ -879,14 +888,14 @@ class Cluster:
         # lock (never held across I/O).
         self._peer_data_ver: dict[tuple[str, str], int] = {}
         self._peer_gen_seen: dict[tuple[str, str], tuple] = {}
-        self._gen_lock = threading.Lock()
+        self._gen_lock = make_lock("peer-gen")
         # Anti-entropy observability (docs/robustness.md): failures as
         # DATA, not just a log line — counters ride self.stats
         # (antientropy.errors / antientropy.repairs), and the last
         # error/success land here for /debug/vars.  _ae_lock is a leaf
         # lock.
         self.stats = stats
-        self._ae_lock = threading.Lock()
+        self._ae_lock = make_lock("anti-entropy")
         self._ae_last_error: str | None = None
         self._ae_last_error_ts: float | None = None
         self._ae_last_success_ts: float | None = None
@@ -905,7 +914,7 @@ class Cluster:
         # probe_peers() call must not interleave, or a pass that gathered
         # its results while a peer was still dead could apply a stale
         # DOWN after a newer pass already marked the recovered peer READY
-        self._probe_serial = threading.Lock()
+        self._probe_serial = make_lock("probe-serial")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -1031,6 +1040,8 @@ class Cluster:
                         "membership": self._membership(),
                         "replicaN": self.replica_n,
                         "epoch": self.epoch})
+                # lint: allow(swallowed-exception) — DOWN is the
+                # handling: probe reconciliation re-pushes next pass
                 except Exception:
                     n.state = NODE_DOWN
                     continue
@@ -1059,6 +1070,8 @@ class Cluster:
                         "type": "apply-schema",
                         "schema": self.holder.schema(),
                     })
+                # lint: allow(swallowed-exception) — DOWN is the
+                # handling: the next recovery probe retries catch-up
                 except Exception:
                     n.state = NODE_DOWN
         # an outstanding resize job whose members are all current resolves
@@ -2118,7 +2131,10 @@ class Cluster:
                 try:
                     blob = self.client.fragment_data(
                         host, index, field, view, shard)
-                except Exception:
+                except Exception as e:
+                    self._note_ae_error(
+                        f"fragment_data {index}/{field}/{view}/{shard} "
+                        f"from {nid}", e)
                     continue
                 rows, cols = unpack_roaring(blob, self.holder.max_row_id)
                 idx = self.holder.index(index)
@@ -2337,7 +2353,11 @@ class Cluster:
         try:
             with open(path) as f:
                 return json.load(f)
-        except Exception:
+        except Exception as e:
+            # a corrupt/torn job record reads as "no resize in flight" —
+            # that must be visible, not a silent shrug, because the
+            # interrupted resize's revert pushes will never happen
+            self._note_ae_error(f"resize-job load {path}", e)
             return None
 
     def _clear_resize_job(self):
@@ -2372,8 +2392,10 @@ class Cluster:
                 continue
             try:
                 self.client.send_message(m["uri"], done_msg, timeout=5.0)
+            # lint: allow(swallowed-exception) — ok=False keeps the job
+            # record; probe reconciliation keeps pushing
             except Exception:
-                ok = False  # probe reconciliation keeps pushing
+                ok = False
         self.handle_message(done_msg)
         # nodes the interrupted resize was removing still need their
         # single-node revert, or they stay latched RESIZING forever (the
@@ -2384,6 +2406,8 @@ class Cluster:
                     "type": "resize-complete",
                     "membership": [m], "replicaN": 1, "epoch": epoch},
                     timeout=5.0)
+            # lint: allow(swallowed-exception) — ok=False keeps the job
+            # record; the probe safety net re-pushes the revert
             except Exception:
                 ok = False
         if ok:
@@ -2445,8 +2469,10 @@ class Cluster:
                     self.client.send_message(
                         host, {"type": "set-state",
                                "state": STATE_RESIZING})
+                # lint: allow(swallowed-exception) — DOWN old member;
+                # fetch sources skip it anyway
                 except Exception:
-                    pass  # DOWN old member; fetch sources skip it anyway
+                    pass
         completed = False
         try:
             # per-node fetch lists: (index, shard) pairs the node will own
@@ -2527,6 +2553,9 @@ class Cluster:
                     try:
                         self.client.send_message(hosts[nid], done_msg)
                         unacked.discard(nid)
+                    # lint: allow(swallowed-exception) — stragglers stay
+                    # in `unacked` and are marked DOWN below; the epoch-
+                    # gated re-push loop owns convergence
                     except Exception:
                         pass
                 if not unacked:
@@ -2541,6 +2570,9 @@ class Cluster:
                         "type": "resize-complete",
                         "membership": [{"id": n.id, "uri": n.host}],
                         "replicaN": 1, "epoch": new_epoch})
+                # lint: allow(swallowed-exception) — best-effort notify
+                # of a node leaving the cluster; the probe safety net in
+                # probe_peers re-delivers the single-node revert
                 except Exception:
                     pass
             if unacked:
@@ -2563,6 +2595,9 @@ class Cluster:
                             self.client.send_message(
                                 host, {"type": "set-state",
                                        "state": STATE_NORMAL})
+                        # lint: allow(swallowed-exception) — abort-path
+                        # state restore; an unreachable participant
+                        # unlatches via the probe_peers safety net
                         except Exception:
                             pass
             if self.state == STATE_RESIZING:
@@ -2665,8 +2700,10 @@ class Cluster:
         if not self._closing.is_set() and self.state != STATE_RESIZING:
             try:
                 self._holder_cleaner()
-            except Exception:
-                pass
+            except Exception as e:
+                # a dead cleaner means unowned fragments pile up
+                # invisibly; surface it on the AE health counters
+                self._note_ae_error("holder cleaner", e)
 
     def _holder_cleaner(self):
         """Drop fragments this node no longer owns under the current
@@ -2680,6 +2717,9 @@ class Cluster:
                             frag = v.fragments.pop(shard)
                             try:
                                 frag.close()
+                            # lint: allow(swallowed-exception) — the
+                            # fragment is already unowned and popped; a
+                            # close failure leaks an fd, not data
                             except Exception:
                                 pass
 

@@ -76,6 +76,7 @@ from ..utils import devobs as _devobs
 from ..utils import profile as qprof
 from ..utils.deadline import check_current
 from ..utils.faults import FAULTS
+from ..utils.locks import make_lock, make_rlock
 from ..utils.tracing import GLOBAL_TRACER
 
 # shard_map moved from jax.experimental (kwarg check_rep) to the jax
@@ -149,9 +150,7 @@ def _unpack_frags(layout, arrays):
 # around every collective-program LAUNCH (shard_map executables and
 # sharded-output indexing) restores a global enqueue order; execution
 # itself stays async and overlapped, only the enqueue serializes.
-import threading as _threading
-
-_DISPATCH_LOCK = _threading.Lock()
+_DISPATCH_LOCK = make_lock("dispatch")
 
 
 class _InstrumentedExec:
@@ -271,7 +270,6 @@ class MeshExecutor:
         # stale entry (shard set grew, index deleted) pins a full stacked
         # copy of its fragments in device memory until evicted.
         from collections import OrderedDict
-        import threading
         from ..storage.membudget import DEFAULT_BUDGET
         self._stack_cache: OrderedDict = OrderedDict()
         self.stack_cache_max = 64
@@ -285,7 +283,7 @@ class MeshExecutor:
         # threads race on the dict, and a callback taking the main
         # executor lock could deadlock two executors evicting each other's
         # entries.
-        self._sc_lock = threading.Lock()
+        self._sc_lock = make_lock("stack-cache")
         import weakref
         self._finalizer = weakref.finalize(
             self, MeshExecutor._cleanup_budget, self._budget, id(self),
@@ -294,7 +292,7 @@ class MeshExecutor:
         # overlaps in-flight query batches to hide the dispatch round
         # trip); the lock covers the python-side cache bookkeeping only —
         # device dispatch runs outside it.
-        self._lock = threading.RLock()
+        self._lock = make_rlock("mesh-exec")
 
     # -- compiled executables ---------------------------------------------
 
@@ -1294,20 +1292,18 @@ class MeshExecutor:
                                  pshapes)
             fn = self._cache.get(key)
             if fn is None:
-                fplan = slotted_filter
-
                 def per_shard(params_, *arrays, _layout=layout,
-                              _k0=pkeys[0]):
+                              _k0=pkeys[0], _fplan=slotted_filter):
                     frags = _unpack_frags(_layout, arrays)
                     frag = frags[_k0]                  # [rows, W]
-                    if fplan is None:
+                    if _fplan is None:
                         counts = jnp.sum(
                             jax.lax.population_count(frag).astype(jnp.int32),
                             axis=-1)                   # [rows]
                         return jnp.broadcast_to(
                             counts, (params_.shape[0],) + counts.shape)
                     masks = jax.vmap(
-                        lambda p: eval_plan(fplan, frags, p))(params_)
+                        lambda p: eval_plan(_fplan, frags, p))(params_)
                     masked = frag[None, :, :] & masks[:, None, :]
                     return jnp.sum(
                         jax.lax.population_count(masked).astype(jnp.int32),
@@ -1350,19 +1346,17 @@ class MeshExecutor:
             key = self._plan_key("bsi_sumB", slotted_filter, pkeys, pshapes)
             fn = self._cache.get(key)
             if fn is None:
-                fplan = slotted_filter
-
                 def per_shard(params_, *arrays, _layout=layout,
-                              _k0=pkeys[0]):
+                              _k0=pkeys[0], _fplan=slotted_filter):
                     frags = _unpack_frags(_layout, arrays)
                     frag = frags[_k0]
-                    if fplan is None:
+                    if _fplan is None:
                         counts = bsi.sum_counts(frag, None)
                         return jnp.broadcast_to(
                             counts, (params_.shape[0],) + counts.shape)
 
                     def one(p):
-                        return bsi.sum_counts(frag, eval_plan(fplan, frags,
+                        return bsi.sum_counts(frag, eval_plan(_fplan, frags,
                                                               p))
 
                     return jax.vmap(one)(params_)      # [B, 2, depth+1]
@@ -1637,6 +1631,9 @@ class _ShardSchedule:
                 try:
                     for k in fut.result():
                         budget.unpin(k)
+                # lint: allow(swallowed-exception) — unpin cleanup in a
+                # finally; a failed prefetch already surfaces as a stage
+                # miss (budget.prefetch_misses) and a re-upload
                 except (Exception, futures.CancelledError):
                     pass
 

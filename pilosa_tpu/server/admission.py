@@ -25,8 +25,9 @@ armor (Server.close/drain)."""
 from __future__ import annotations
 
 import math
-import threading
 import time
+
+from ..utils.locks import make_condition
 
 
 class AdmissionRejected(Exception):
@@ -55,7 +56,7 @@ class AdmissionController:
             else max(1, 2 * max_slots)
         self.stats = stats
         self.name = name
-        self._cond = threading.Condition(threading.Lock())
+        self._cond = make_condition("admission")
         self.in_use = 0
         self.waiting = 0
         self.draining = False

@@ -23,6 +23,7 @@ from .. import __version__
 from ..api import API, ApiError, ConflictError, DisallowedError, NotFoundError
 from ..storage.fragment import FragmentQuarantinedError
 from ..utils import degraded
+from ..utils.locks import make_lock
 from ..utils import profile as qprof
 from ..utils.deadline import (DEADLINE_HEADER, DeadlineExceeded,
                               QueryContext, activate)
@@ -616,6 +617,17 @@ def build_router(api: API, server=None) -> Router:
 
     r.add("GET", "/debug/dashboard", debug_dashboard)
 
+    def debug_locks(req, args):
+        """Lock-order race detector dump (docs/static-analysis.md):
+        the acquisition-order graph over named lock classes plus any
+        order-inversion/same-class-nesting violations.  Populated only
+        when the process runs with PILOSA_TPU_LOCKCHECK set; unarmed it
+        reports armed=false with empty tables."""
+        from ..utils import locks
+        return locks.report()
+
+    r.add("GET", "/debug/locks", debug_locks)
+
     # -- pprof-style profiling (handler.go:280 /debug/pprof) ---------------
 
     def pprof_threads(req, args):
@@ -632,7 +644,7 @@ def build_router(api: API, server=None) -> Router:
     r.add("GET", "/debug/pprof/threads", pprof_threads)
 
     import threading as _threading
-    profile_lock = _threading.Lock()
+    profile_lock = make_lock("pprof-profile")
 
     def pprof_profile(req, args):
         """Sampling CPU profile: aggregate all-thread stacks at ~100 Hz
@@ -1030,9 +1042,8 @@ class TrackingHTTPServer(ThreadingHTTPServer):
     exit and clients reconnect to the live server."""
 
     def server_bind(self):
-        import threading
         self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = make_lock("server-conns")
         super().server_bind()
 
     def process_request(self, request, client_address):

@@ -597,8 +597,11 @@ class Server:
         while not self._closing.wait(self.config.metric_poll_interval):
             try:
                 self.collect_runtime_stats()
-            except Exception:
-                pass
+            except Exception as e:
+                # a monitor that dies silently leaves gauges frozen at
+                # their last values — indistinguishable from a healthy
+                # quiet server (the PR 6 swallow class)
+                self.logger.error(f"runtime stats poll failed: {e}")
 
     def sample_timeseries(self, force: bool = False) -> bool:
         """One time-series sample (docs/observability.md "Device
@@ -667,8 +670,10 @@ class Server:
         while not self._closing.wait(self.config.timeseries_interval):
             try:
                 self.sample_timeseries()
-            except Exception:
-                pass
+            except Exception as e:
+                # a silently dead sampler shows a flat-lined
+                # /debug/timeseries that reads as "idle", not "broken"
+                self.logger.error(f"time-series sample failed: {e}")
 
     def _monitor_anti_entropy(self):
         """(server.go:514 monitorAntiEntropy)"""

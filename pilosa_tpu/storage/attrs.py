@@ -11,9 +11,9 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import threading
 
 from ..utils.durable import durable_replace, fsync_file
+from ..utils.locks import make_rlock
 
 _BLOCK_SIZE = 100  # ids per checksum block (attr.go attrBlockSize)
 
@@ -27,7 +27,7 @@ class AttrStore:
         # sync pulls the content back from peers — attrs are repairable
         # metadata, so startup must not die on them)
         self.corrupt: str | None = None
-        self._lock = threading.RLock()
+        self._lock = make_rlock("attrs")
         if path is not None and os.path.exists(path):
             try:
                 with open(path) as f:

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 from dataclasses import dataclass
 from datetime import datetime
 
@@ -23,6 +22,7 @@ from ..core import (
     VIEW_STANDARD,
 )
 from ..ops import bsi
+from ..utils.locks import make_rlock
 from .attrs import AttrStore
 from . import time_quantum as tq
 from .view import View
@@ -168,7 +168,7 @@ class Field:
         self.views: dict[str, View] = {}
         self.row_attrs = AttrStore(
             None if path is None else os.path.join(path, ".row_attrs"))
-        self._lock = threading.RLock()
+        self._lock = make_rlock("field")
         # shards known to have data on remote nodes (field.go:263)
         self.remote_available_shards: set[int] = set()
         # row-key translation (field.go: per-field TranslateStore)

@@ -42,7 +42,6 @@ import itertools
 import json
 import os
 import struct
-import threading
 
 import numpy as np
 
@@ -56,6 +55,7 @@ from ..core import (
 from ..ops import bitset, bsi
 from ..utils.durable import checksum, durable_replace, fsync_dir, fsync_file
 from ..utils.faults import FAULTS
+from ..utils.locks import make_lock, make_rlock
 from . import membudget as _membudget
 from .membudget import DEFAULT_BUDGET, HOST_STAGE_BUDGET, INGEST_DELTA_BUDGET
 from .roaring_io import SnapshotFormatError, pack_snapshot, unpack_snapshot
@@ -111,7 +111,7 @@ COMPRESS_MAX_DENSITY = 0.5
 # Server.update_storage_gauges): process-wide, like the knobs above.
 _EVENTS = {"quarantine": 0, "torn_tail_recovered": 0, "repair": 0,
            "attr_corrupt": 0}
-_EVENTS_LOCK = threading.Lock()
+_EVENTS_LOCK = make_lock("fragment-events")
 
 # True once ANY fragment in this process has entered quarantine
 # (including sidecar re-detection, which doesn't count an event).
@@ -247,7 +247,7 @@ class Fragment:
         self._op_n = 0
         self._dirty_data = False  # mutated since last snapshot?
         self._wal_file = None
-        self._lock = threading.RLock()
+        self._lock = make_rlock("fragment")
 
         if path is not None:
             self._open_storage()

@@ -4,11 +4,11 @@ from __future__ import annotations
 
 import os
 import shutil
-import threading
 
 from .fragment import Fragment
 from .index import Index
 from .field import Field, FieldOptions
+from ..utils.locks import make_rlock
 
 
 class Holder:
@@ -23,7 +23,7 @@ class Holder:
         # None = local file-backed stores (cluster replicas set a
         # coordinator-routed factory before open())
         self.translate_factory = None
-        self._lock = threading.RLock()
+        self._lock = make_rlock("holder")
 
     # -- lifecycle (holder.go:137 Open) ------------------------------------
 

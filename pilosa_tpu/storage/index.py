@@ -5,13 +5,13 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 
 import numpy as np
 
 from ..core import EXISTENCE_FIELD_NAME, VIEW_STANDARD
 from .attrs import AttrStore
 from .field import Field, FieldOptions, FIELD_TYPE_SET, CACHE_TYPE_NONE
+from ..utils.locks import make_rlock
 
 
 class IndexError_(ValueError):
@@ -40,7 +40,7 @@ class Index:
         # (cluster replicas swap in a coordinator-routed store)
         self.translate_factory = None
         self._translate_store = None
-        self._lock = threading.RLock()
+        self._lock = make_rlock("index")
 
         if create and track_existence:
             self._open_existence_field()

@@ -26,9 +26,10 @@ same LRU machinery — there "upload bytes" counts staged host bytes.
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Callable
+
+from ..utils.locks import make_rlock
 
 
 class DeviceBudget:
@@ -41,6 +42,7 @@ class DeviceBudget:
         self._peak = 0
         self.evictions = 0
         self.evicted_bytes = 0  # an eviction storm's size, not just count
+        self.evict_errors = 0   # callbacks that raised (leaked residency)
         # streaming pipeline counters (parallel/mesh_exec.py): bytes
         # (re-)registered = bytes shipped to the device, and whether a
         # scheduled slice's prefetch completed before the consumer
@@ -48,7 +50,7 @@ class DeviceBudget:
         self.upload_bytes = 0
         self.prefetch_hits = 0
         self.prefetch_misses = 0
-        self._lock = threading.RLock()
+        self._lock = make_rlock("budget")
 
     @property
     def resident_bytes(self) -> int:
@@ -83,13 +85,19 @@ class DeviceBudget:
             to_evict.append(cb)
         return to_evict
 
-    @staticmethod
-    def _run_evictions(to_evict: list[Callable[[], None]]):
+    def _run_evictions(self, to_evict: list[Callable[[], None]]):
         for cb in to_evict:
             try:
                 cb()
             except Exception:
-                pass
+                # the entry is already unaccounted; a failed callback
+                # means its owner may still hold the buffer (leaked
+                # residency) — that must be visible in stats(), not
+                # silent (the budget itself must survive regardless).
+                # Counted under the lock like every other counter:
+                # callbacks run outside it, so concurrent failures race.
+                with self._lock:
+                    self.evict_errors += 1
 
     def register(self, key: tuple, nbytes: int, evict: Callable[[], None],
                  compressed_bytes: int = 0):
@@ -185,6 +193,7 @@ class DeviceBudget:
                 "entries": len(self._entries),
                 "evictions": self.evictions,
                 "evictedBytes": self.evicted_bytes,
+                "evictErrors": self.evict_errors,
                 "uploadBytes": self.upload_bytes,
                 "prefetchHits": self.prefetch_hits,
                 "prefetchMisses": self.prefetch_misses,

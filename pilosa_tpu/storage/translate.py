@@ -19,7 +19,8 @@ from __future__ import annotations
 
 import os
 import struct
-import threading
+
+from ..utils.locks import make_rlock
 
 _REC = struct.Struct("<I")  # key byte-length; key bytes follow
 
@@ -32,7 +33,7 @@ class TranslateStore:
         self._key_to_id: dict[str, int] = {}
         self._id_to_key: dict[int, str] = {}
         self._file = None
-        self._lock = threading.RLock()
+        self._lock = make_rlock("translate")
         if path is not None:
             self._open()
 

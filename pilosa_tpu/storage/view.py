@@ -7,10 +7,10 @@ Names: "standard", time views "standard_YYYY[MM[DD[HH]]]", and BSI views
 from __future__ import annotations
 
 import os
-import threading
 
 from ..core import VIEW_STANDARD
 from .fragment import Fragment
+from ..utils.locks import make_rlock
 
 
 class View:
@@ -34,7 +34,7 @@ class View:
         self.cache_type = cache_type
         self.cache_size = cache_size
         self.fragments: dict[int, Fragment] = {}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("view")
 
     def fragment(self, shard: int) -> Fragment | None:
         return self.fragments.get(shard)

@@ -41,6 +41,7 @@ import threading
 import time
 from collections import OrderedDict, deque
 
+from .locks import make_lock
 from .stats import BucketHistogram
 
 
@@ -84,7 +85,7 @@ class CompileRegistry:
     MAX_ENTRIES = 512  # bounds /debug/compiles (LRU on compile recency)
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("compile-registry")
         self._local = threading.local()
         self._entries: OrderedDict[str, dict] = OrderedDict()
         self.compiles_total = 0
@@ -153,6 +154,9 @@ class CompileRegistry:
                 log.event("device.retrace", sig=sig, kind=kind,
                           compiles=n, compileS=round(dur_s, 4),
                           prevShapes=prev_fp, shapes=fp)
+            # lint: allow(swallowed-exception) — a stale/closed log
+            # stream costs a log line, never the dispatch; the retrace
+            # is still counted in the compile registry above
             except Exception:
                 pass
         try:
@@ -164,6 +168,9 @@ class CompileRegistry:
                     {"sig": sig, "kind": kind, "compiles": n,
                      "prevShapes": prev_fp, "shapes": fp},
                     collect=ctx.collect)
+        # lint: allow(swallowed-exception) — span synthesis is best-
+        # effort decoration; the registry + log line above already
+        # recorded the retrace, and tracing must never fail a dispatch
         except Exception:
             pass
         return True
@@ -237,7 +244,7 @@ class LaunchLedger:
     pow-2 query-axis padding show up in one waste ratio."""
 
     def __init__(self, size: int = 256):
-        self._lock = threading.Lock()
+        self._lock = make_lock("launch-ledger")
         self.size = max(int(size), 1)
         self._ring: deque = deque(maxlen=self.size)
         self.launches_total = 0

@@ -22,6 +22,8 @@ class DiagnosticsCollector:
         self.server = server
         self.endpoint = endpoint
         self.interval = interval
+        # lint: allow(wall-clock) — uptime is operator display on the
+        # diagnostics report, never a perf measurement
         self.start_time = time.time()
         self._closing = threading.Event()
         self._thread = None
@@ -43,6 +45,8 @@ class DiagnosticsCollector:
             "version": __version__,
             "platform": platform.platform(),
             "python": platform.python_version(),
+            # lint: allow(wall-clock) — uptime display; second-scale
+            # NTP slew is irrelevant at hour granularity
             "uptimeSeconds": int(time.time() - self.start_time),
             "numIndexes": len(holder.indexes),
             "numFields": n_fields,

@@ -35,8 +35,9 @@ test suite, env-armed).
 
 from __future__ import annotations
 
-import threading
 import time
+
+from .locks import make_lock
 
 
 class FaultInjected(OSError):
@@ -59,7 +60,7 @@ class _Fault:
 class FaultRegistry:
     def __init__(self):
         self._faults: dict[str, _Fault] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults")
 
     def arm(self, name: str, mode: str = "error", arg: float = 0.0,
             match: str | None = None, times: int | None = None):

@@ -13,15 +13,16 @@ into per-generation counters surfaced as ``runtime.gc_*`` gauges
 from __future__ import annotations
 
 import gc
-import threading
 import time
+
+from .locks import make_lock
 
 
 class GcNotifier:
     """Aggregates gc.callbacks events; safe to create/close repeatedly."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("gcnotify")
         self.collections = [0, 0, 0]
         self.pause_s = [0.0, 0.0, 0.0]
         self.collected = 0   # objects reclaimed by the cycle collector
@@ -59,7 +60,7 @@ class GcNotifier:
 
 
 _global = None
-_global_lock = threading.Lock()
+_global_lock = make_lock("gcnotify-global")
 
 
 def global_notifier() -> GcNotifier:

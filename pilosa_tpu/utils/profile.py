@@ -26,9 +26,10 @@ happen."""
 from __future__ import annotations
 
 import contextvars
-import threading
 import time
 from contextlib import contextmanager
+
+from .locks import make_lock
 
 
 class ProfileNode:
@@ -59,7 +60,7 @@ class QueryProfile:
         self.root = ProfileNode("query")
         self._t0 = time.perf_counter()
         self._stack = [self.root]
-        self._lock = threading.Lock()
+        self._lock = make_lock("profile")
 
     @contextmanager
     def stage(self, name: str):

@@ -11,9 +11,10 @@ tagged at the HTTP edge and never reach record()."""
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
+
+from .locks import make_lock
 
 # Query text stored per entry is truncated to this many characters: the
 # log must bound memory even against megabyte PQL bodies.
@@ -28,7 +29,7 @@ class SlowQueryLog:
         self.logger = logger
         self.stats = stats
         self._entries: deque = deque(maxlen=self.size)
-        self._lock = threading.Lock()
+        self._lock = make_lock("slowlog")
         self.recorded = 0
 
     @property

@@ -12,9 +12,10 @@ bucketed latency distributions) and exported as proper Prometheus
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import defaultdict
+
+from .locks import make_lock
 
 # Inclusive upper edges for timing histograms: 1-2.5-5 per decade from
 # 100 µs to 100 s (values above land in +Inf).  Fixed and shared by every
@@ -77,7 +78,7 @@ class StatsClient:
 
     def __init__(self, tags: list[str] | None = None):
         self.tags = tags or []
-        self._lock = threading.Lock()
+        self._lock = make_lock("stats")
         self._counts: dict[str, float] = defaultdict(float)
         self._gauges: dict[str, float] = {}
         # per-series log-bucket histograms — NOT raw samples: always-on
@@ -224,7 +225,7 @@ class BucketHistogram:
     def __init__(self, bounds):
         self.bounds = list(bounds)
         self._counts = [0] * (len(self.bounds) + 1)
-        self._lock = threading.Lock()
+        self._lock = make_lock("stats")
         self.count = 0
         self.total = 0.0
 
@@ -272,7 +273,7 @@ class ReservoirTimer:
         self.size = size
         self._buf: list[float] = []
         self._pos = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("stats")
         self.count = 0
 
     def observe(self, v: float):

@@ -22,11 +22,11 @@ few hundred KB, independent of uptime.
 from __future__ import annotations
 
 import math
-import threading
 import time
 from collections import deque
 
 from .devobs import _wall_stamp
+from .locks import make_lock
 
 
 class TimeSeriesRing:
@@ -48,7 +48,7 @@ class TimeSeriesRing:
         self.capacity = max(
             2, int(math.ceil(self.window_s / self.interval_s)) + 1)
         self._ring: deque = deque(maxlen=self.capacity)
-        self._lock = threading.Lock()
+        self._lock = make_lock("timeseries")
         self._now = now_fn
         self._t0 = now_fn()
         self._last_t: float | None = None

@@ -20,11 +20,12 @@ from __future__ import annotations
 
 import contextvars
 import random
-import threading
 import time
 import uuid
 from contextlib import contextmanager
 from typing import NamedTuple
+
+from .locks import make_lock
 
 TRACE_HEADER = "X-Pilosa-Tpu-Trace"
 # Requests tagged with this header are health/status probes: background
@@ -79,6 +80,8 @@ class Span:
         # perf_counter pair — a wall-clock step (NTP slew, manual set)
         # mid-span must not produce negative/garbage durations in
         # /debug/traces
+        # lint: allow(wall-clock) — display-only span start stamp;
+        # durations come from the perf_counter pair below
         self.start = time.time()
         self._pc_start = time.perf_counter()
         self.end: float | None = None
@@ -117,7 +120,7 @@ class Tracer:
         self.max_spans = max_spans
         self.sample_rate = 1.0
         self._spans: list = []  # Span objects or adopted remote dicts
-        self._lock = threading.Lock()
+        self._lock = make_lock("tracer")
 
     def _record(self, span: Span):
         if span._collect is not None:

@@ -1,110 +1,20 @@
 #!/usr/bin/env bash
-# Repo hygiene check: byte-compile everything and grep-lint the two
-# recurring review findings — wall-clock time.time() in span/duration
-# timing (r2 verdict: durations must come from perf_counter pairs) and
-# bare `except:` clauses (swallow KeyboardInterrupt/SystemExit).
-# Run locally or from CI (.github/workflows/ci.yml).
+# Repo hygiene check: byte-compile everything, run the project invariant
+# analyzer (pilosa_tpu/analysis — the AST lint suite that replaced the
+# old grep-lints; docs/static-analysis.md has the rule catalog), and run
+# the storage-durability fast suite.  Run locally or from CI
+# (.github/workflows/ci.yml).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 python -m compileall -q pilosa_tpu tests scripts bench.py
 
-# time.time() is allowed only at the annotated wall-clock sites:
-# diagnostics uptime reporting, the tracing span's display-only start
-# stamp (durations there come from a perf_counter pair), and the
-# _wall_stamp helpers (anti-entropy last-error/last-success stamps, the
-# launch ledger + time-series sample stamps — operator display, never
-# subtracted; devobs/timeseries durations and interval pacing all come
-# from perf_counter).
-bad=$(grep -rn "time\.time()" pilosa_tpu bench.py \
-    | grep -v "pilosa_tpu/utils/diagnostics.py" \
-    | grep -v "self\.start = time\.time()" \
-    | grep -v "_wall_stamp" || true)
-if [ -n "$bad" ]; then
-    echo "FAIL: wall-clock time.time() in timing code (use" \
-         "time.perf_counter pairs; see utils/tracing.py):"
-    echo "$bad"
-    exit 1
-fi
-
-# bare `except:` swallows KeyboardInterrupt/SystemExit — name a type.
-bad=$(grep -rnE --include="*.py" "except[[:space:]]*:" \
-    pilosa_tpu tests scripts bench.py || true)
-if [ -n "$bad" ]; then
-    echo "FAIL: bare 'except:' clause (name an exception type):"
-    echo "$bad"
-    exit 1
-fi
-
-# Device dispatch must flow through the dispatch batcher (docs/batching.md):
-# a direct shard_map-reducer call outside parallel/ bypasses cross-query
-# fusion, the queued-deadline drop-out, and the dispatch stats.  Everything
-# goes through DispatchBatcher's same-named wrappers (or its explicit
-# disabled-mode fallback); only parallel/ touches the executables.
-bad=$(grep -rnE --include="*.py" \
-    "(mesh|mesh_exec)\.(count_async|count_batch_async|segments|segments_batch|row_counts|bsi_sum|bsi_min_max|group_counts)" \
-    pilosa_tpu --exclude-dir=parallel || true)
-if [ -n "$bad" ]; then
-    echo "FAIL: direct mesh shard_map dispatch outside parallel/ (route" \
-         "through executor.batcher — parallel/batcher.py):"
-    echo "$bad"
-    exit 1
-fi
-
-# Metrics-docs lint (docs/observability.md): every stats metric name in
-# the tree must appear in the catalog, and every catalog row must match a
-# call site — the catalog is the operator's contract, and a dangling row
-# or an undocumented series are both drift.  Dynamic f-string segments
-# in code and <...> placeholders in the docs both normalize to "*".
-python - <<'PYEOF'
-import fnmatch
-import pathlib
-import re
-import sys
-
-root = pathlib.Path("pilosa_tpu")
-code: set[str] = set()
-CALL = re.compile(
-    r'[a-z_]*stats\.(?:count|gauge|timing|timer|histogram)\(\s*(f?)"([^"]+)"',
-    re.S)
-HELPER = re.compile(r"\b_count\(")  # dotted-name prefix helpers
-NAME = re.compile(r'"([a-z0-9_]+(?:\.[a-z0-9_{}.]+)+)"')
-for path in root.rglob("*.py"):
-    text = path.read_text()
-    for is_f, name in CALL.findall(text):
-        if is_f:
-            name = re.sub(r"\{[^}]*\}", "*", name)
-        code.add(name)
-    for m in HELPER.finditer(text):
-        # capture every dotted literal near the helper call (covers
-        # conditional-expression names like "a.hit" if ... else "a.miss")
-        for name in NAME.findall(text[m.end():m.end() + 160]):
-            code.add(re.sub(r"\{[^}]*\}", "*", name))
-
-doc_text = pathlib.Path("docs/observability.md").read_text()
-m = re.search(r"<!-- metrics-catalog:begin -->(.*?)"
-              r"<!-- metrics-catalog:end -->", doc_text, re.S)
-if not m:
-    sys.exit("FAIL: docs/observability.md is missing the "
-             "metrics-catalog markers")
-docs = {re.sub(r"<[^>]*>", "*", n)
-        for n in re.findall(r"^\| `([^`]+)`", m.group(1), re.M)}
-
-undocumented = sorted(
-    c for c in code if not any(fnmatch.fnmatch(c, d) for d in docs))
-dangling = sorted(
-    d for d in docs if not any(fnmatch.fnmatch(c, d) for c in code))
-if undocumented:
-    print("FAIL: metric names missing from the docs/observability.md "
-          "catalog:")
-    print("  " + "\n  ".join(undocumented))
-if dangling:
-    print("FAIL: docs/observability.md catalog rows matching no call "
-          "site:")
-    print("  " + "\n  ".join(dangling))
-if undocumented or dangling:
-    sys.exit(1)
-PYEOF
+# Project invariant analyzer: traced-closure capture, wall-clock timing,
+# bare/swallowed excepts, batcher bypass, cross-thread context
+# discipline, metrics-docs catalog, failpoint-name catalog.  Inline
+# suppressions require a reason; the analyzer exits non-zero on any
+# finding (run `pilosa-tpu analyze --list-rules` for the rule list).
+python -m pilosa_tpu.analysis
 
 # Storage durability fast suite (docs/robustness.md "Durability &
 # recovery"): the byte-level corruption fuzz (truncate/flip at every
@@ -124,8 +34,9 @@ JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' -p no:cacheprovider \
     tests/test_durability.py tests/test_crash.py tests/test_containers.py \
     tests/test_device_obs.py tests/test_ingest.py
 
-# committed bytecode/cache artifacts must never land in the tree
-bad=$(git ls-files | grep -E "__pycache__|\.pyc$" || true)
+# committed bytecode/cache artifacts must never land in the tree (shell
+# stays the right layer for a git-index check)
+bad=$(git ls-files -- '*.pyc' '*__pycache__*' || true)
 if [ -n "$bad" ]; then
     echo "FAIL: committed __pycache__/.pyc artifacts:"
     echo "$bad"

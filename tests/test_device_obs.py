@@ -216,7 +216,17 @@ def test_debug_surfaces_served_and_probe_excluded(tmp_path):
         _req(p, "POST", "/index/i", {})
         _req(p, "POST", "/index/i/field/f", {})
         _req(p, "POST", "/index/i/query", "Count(Row(f=1))")
-        hist0 = srv.stats.snapshot()["timings"]["http.request"]["count"]
+        # post-request accounting runs AFTER the response is sent
+        # (handler._observe in the finally block); poll until all three
+        # requests above have landed or the late increment would read
+        # as a probe-exclusion leak below
+        import time
+        deadline = time.perf_counter() + 5
+        while time.perf_counter() < deadline:
+            hist0 = srv.stats.snapshot()["timings"]["http.request"]["count"]
+            if hist0 >= 3:
+                break
+            time.sleep(0.01)
         body, _ = _get(p, "/debug/compiles")
         comp = json.loads(body)
         assert comp["compiles"] > 0 and "entries" in comp

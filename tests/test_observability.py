@@ -6,6 +6,7 @@ round-tripped through a minimal text parser."""
 
 import json
 import socket
+import time
 import urllib.request
 
 import pytest
@@ -222,7 +223,14 @@ def test_profile_tree_and_slowlog_http(tmp_path):
         assert "profile" not in out3
 
         # every query crossed the 1ns threshold -> slow-query ring
-        slow, _ = _req(p, "GET", "/debug/slow")
+        # (recording runs AFTER the response is sent — handler._observe
+        # in the finally block — so poll rather than race it)
+        deadline = time.perf_counter() + 5
+        while True:
+            slow, _ = _req(p, "GET", "/debug/slow")
+            if slow["recorded"] >= 4 or time.perf_counter() > deadline:
+                break
+            time.sleep(0.01)
         assert slow["recorded"] >= 4
         entry = slow["entries"][-1]
         assert entry["index"] == "i"
@@ -259,7 +267,19 @@ def test_probes_excluded_from_histograms_and_slowlog(tmp_path):
                     t.get("http.query", {}).get("count", 0),
                     dv["slowLog"]["recorded"])
 
-        req0, query0, slow0 = counts()
+        def settled(min_query):
+            # post-request accounting runs AFTER the response is sent
+            # (handler._observe in the finally block), so a /debug/vars
+            # read can race it; poll until the expected query count
+            # lands before asserting
+            deadline = time.perf_counter() + 5
+            c = counts()
+            while c[1] < min_query and time.perf_counter() < deadline:
+                time.sleep(0.01)
+                c = counts()
+            return c
+
+        req0, query0, slow0 = settled(1)
         assert req0 >= 1 and query0 >= 1 and slow0 >= 1
         # background paths: status/metrics/debug never reach the
         # histograms (the /debug/vars reads above are themselves exempt)
@@ -276,7 +296,7 @@ def test_probes_excluded_from_histograms_and_slowlog(tmp_path):
         assert (req1, query1, slow1) == (req0, query0, slow0)
         # an untagged query still counts everywhere
         _req(p, "POST", "/index/i/query", "Count(Row(f=1))")
-        req2, query2, slow2 = counts()
+        req2, query2, slow2 = settled(query0 + 1)
         assert (req2, query2, slow2) == (req0 + 1, query0 + 1, slow0 + 1)
         # background requests never root recorded traces either — probe
         # cadence must not evict real query traces from the span ring

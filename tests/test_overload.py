@@ -99,6 +99,8 @@ def _setup(port, index="ov", n_shards=4):
 # -- unit: failpoint registry ----------------------------------------------
 
 def test_faults_registry_spec_and_times():
+    # lint: allow(failpoint-names) — registry unit test arms synthetic
+    # names on purpose; no trigger site should exist for them
     FAULTS.configure("a.b=error@key1#2; c.d=delay:0.01")
     # match filter: a miss doesn't trigger or consume
     FAULTS.hit("a.b", key="other")
@@ -117,8 +119,10 @@ def test_faults_registry_spec_and_times():
 
 def test_faults_bad_spec_rejected():
     with pytest.raises(ValueError):
+        # lint: allow(failpoint-names) — malformed-spec rejection test
         FAULTS.configure("oops")
     with pytest.raises(ValueError):
+        # lint: allow(failpoint-names) — unknown-mode rejection test
         FAULTS.arm("x", mode="explode")
 
 
