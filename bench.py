@@ -1384,6 +1384,162 @@ def run_ingest_smoke(rng) -> dict:
         srv.close()
 
 
+def bench_wholequery(holder, executor, meta, rng):
+    """Whole-query legs (docs/whole-query.md): intersect8 (config-2
+    corpus), bsi_sum (config-4), and filtered TopN (config-3) with the
+    program path on (the serving default — ``executor``) vs a
+    whole-query-off twin, plus the single-launch ledger check.  The
+    on-path intersect8/bsi_sum qps are the numbers the r05 anchors
+    judge; ratio is on/off on identical data and queries."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.utils import devobs
+
+    off = Executor(holder, use_mesh=True, whole_query=False)
+    out = {}
+    try:
+        # Batch/thread sizes are deliberately smaller than the config
+        # 2/3/4 legs: a filtered row_counts launch materialises a
+        # [B, rows, W] masked temp per stacked shard row, and deep
+        # ticket fusion multiplies it — identically on BOTH paths (the
+        # FUSED_ROWS_MAX cap predates this leg and does not scale by
+        # fragment rows), so the on/off ratio is measured at sizes
+        # every host can hold.
+        legs = {
+            "intersect8": ("startrace", lambda: " ".join(
+                "Count(Intersect(" + ", ".join(
+                    f"Row(stargazer={r})" for r in q) + "))"
+                for q in _rand_rows(rng, meta["star_rows"], 1024)),
+                16, 8),
+            "bsi_sum": ("bsi64", lambda: " ".join(
+                f"Sum(Row(v > {int(x)}), field=v)"
+                for x in rng.integers(0, 1_000_000, size=32)), 8, 4),
+            "topn": ("lang10m", lambda: " ".join(
+                f"TopN(language, Row(stars={r}), n=50)"
+                for r in rng.integers(0, 16, size=32)), 8, 4),
+        }
+        for name, (index, mk, nb, T) in legs.items():
+            row = {}
+            for label, ex in (("on", executor), ("off", off)):
+                ex.execute(index, mk())  # warm compile + stacks
+
+                def run(ex=ex, index=index, mk=mk, nb=nb, T=T):
+                    return _run_batches(ex, index,
+                                        [mk() for _ in range(nb)], T)
+
+                d0 = _device_telemetry()
+                (qps, _bat, _p50), spread = best_of(run)
+                dev = _device_delta(d0)
+                row[f"qps_{label}"] = round(qps, 1)
+                row[f"spread_{label}"] = spread
+                if label == "on":
+                    row["device_on"] = dev
+            row["ratio"] = round(row["qps_on"] / row["qps_off"], 3) \
+                if row["qps_off"] else None
+            out[name] = row
+        # acceptance: a Count(Intersect)-class request is ONE ledger
+        # entry of kind wholequery
+        executor.execute(
+            "startrace",
+            "Count(Intersect(Row(stargazer=1), Row(stargazer=2)))")
+        before = devobs.LEDGER.launches_total
+        executor.execute(
+            "startrace",
+            "Count(Intersect(Row(stargazer=3), Row(stargazer=4)))")
+        single = devobs.LEDGER.launches_total - before == 1
+        entry = devobs.LEDGER.snapshot()["entries"][-1]
+        out["single_launch"] = bool(single
+                                    and entry["kind"] == "wholequery")
+        out["wq_requests"] = executor.wq_requests
+        out["wq_fallbacks"] = executor.wq_fallbacks
+    finally:
+        off.close()
+    return out
+
+
+def run_wholequery_smoke(rng) -> dict:
+    """Whole-query leg of --smoke (docs/whole-query.md): a small corpus
+    served with the program path on vs off — answers must be identical,
+    a Count(Intersect)-class request must be exactly ONE launch on the
+    ledger (kind wholequery), and on/off qps ride along (the
+    r05-anchor floor is judged on real hardware by the full bench, not
+    this CPU smoke)."""
+    from pilosa_tpu.core import SHARD_WIDTH
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.storage import FieldOptions, Holder
+    from pilosa_tpu.utils import devobs
+
+    h = Holder(None)
+    idx = h.create_index("wq", track_existence=False)
+    seg = idx.create_field("seg")
+    metric = idx.create_field("metric")
+    v = idx.create_field("v", FieldOptions(type="int", min=0,
+                                           max=100_000))
+    n = 200_000
+    cols = rng.integers(0, 4 * SHARD_WIDTH, size=n)
+    seg.import_bits(rng.integers(0, 8, size=n), cols)
+    metric.import_bits(rng.integers(0, 8, size=n), cols)
+    ucols = np.unique(cols)
+    v.import_values(ucols, rng.integers(0, 100_000, size=ucols.size))
+
+    on = Executor(h, use_mesh=True)
+    off = Executor(h, use_mesh=True, whole_query=False)
+    out = {}
+    try:
+        def batch(B=64):
+            sets = _rand_rows(rng, 8, B)
+            return " ".join(
+                "Count(Intersect(" + ", ".join(
+                    f"Row(seg={r})" for r in q[:4]) + "))"
+                for q in sets)
+
+        qs = [batch() for _ in range(8)]
+        extra = [
+            "Sum(Row(v > 5000), field=v)",
+            "TopN(metric, Intersect(Row(seg=0), Row(seg=2)), n=5)",
+            "Count(Intersect(Row(seg=1), Row(seg=3))) Sum(field=v) "
+            "TopN(metric, n=3)",
+        ]
+
+        def norm(results):  # mixed kinds, unlike the TopN-only _smoke_norm
+            return [[(p.id, p.count) for p in r] if isinstance(r, list)
+                    else r for r in results]
+
+        want = [norm(off.execute("wq", q)) for q in qs + extra]
+        got = [norm(on.execute("wq", q)) for q in qs + extra]
+        out["answers_identical"] = want == got
+        assert out["answers_identical"], \
+            "whole-query answers diverged from the legacy path"
+        # single-launch-per-request, ledger-verified
+        on.execute("wq", "Count(Intersect(Row(seg=2), Row(seg=5)))")
+        before = devobs.LEDGER.launches_total
+        on.execute("wq", "Count(Intersect(Row(seg=0), Row(seg=6)))")
+        launches = devobs.LEDGER.launches_total - before
+        entry = devobs.LEDGER.snapshot()["entries"][-1]
+        out["single_launch"] = bool(launches == 1
+                                    and entry["kind"] == "wholequery")
+        assert out["single_launch"], \
+            f"expected 1 wholequery launch, saw {launches}"
+        out["wq_requests"] = on.wq_requests
+        out["fallbacks"] = on.wq_fallbacks
+
+        d0 = _device_telemetry()
+
+        def timed(ex):
+            t0 = time.perf_counter()
+            served = 0
+            for q in qs:
+                served += len(ex.execute("wq", q))
+            return served / (time.perf_counter() - t0)
+
+        out["qps_off"] = round(timed(off), 1)
+        out["qps_on"] = round(timed(on), 1)
+        out["device"] = _device_delta(d0)
+    finally:
+        on.close()
+        off.close()
+    return out
+
+
 def _smoke_norm(results):
     """TopN results -> comparable (id, count) lists."""
     return [[(p.id, p.count) for p in r] for r in results]
@@ -1667,6 +1823,8 @@ def run_smoke():
     finally:
         DEFAULT_BUDGET.limit_bytes = old_limit
         ex5.close()
+    out["wholequery"] = run_wholequery_smoke(
+        np.random.default_rng(SEED + 9))
     out["compressed"] = run_compressed_smoke(np.random.default_rng(SEED + 6))
     out["ingest"] = run_ingest_smoke(np.random.default_rng(SEED + 8))
     out["cache"] = run_cache_smoke(np.random.default_rng(SEED + 3))
@@ -1769,6 +1927,17 @@ def main():
         traceback.print_exc()
         ingest_leg = None
 
+    # whole-query config (docs/whole-query.md): program path on vs off
+    # on the config-2/3/4 corpora + the single-launch ledger check
+    try:
+        wq_leg = bench_wholequery(holder, executor, meta,
+                                  np.random.default_rng(SEED + 9))
+    except Exception as e:
+        import traceback
+        print(f"whole-query config failed: {e!r}", file=sys.stderr)
+        traceback.print_exc()
+        wq_leg = None
+
     # HTTP variant (engine behind the real server)
     http_qps = None
     try:
@@ -1833,6 +2002,8 @@ def main():
         configs["6_http_dynamic_batching"] = http_batch
     if ingest_leg:
         configs["8_streaming_ingest"] = ingest_leg
+    if wq_leg:
+        configs["9_whole_query"] = wq_leg
 
     print(json.dumps({
         "metric": "engine_intersect8_count_qps_1M_cols",

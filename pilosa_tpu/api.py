@@ -58,13 +58,17 @@ class API:
     def __init__(self, holder: Holder, cluster=None, stats=None,
                  use_mesh: bool = True, dispatch_batch: bool = True,
                  dispatch_batch_max: int = 32,
-                 dispatch_batch_window_us: float = 200.0):
+                 dispatch_batch_window_us: float = 200.0,
+                 whole_query: bool = True,
+                 whole_query_fallback: str = "legacy"):
         """``use_mesh=True`` (the default, config-gated by the server)
         executes served queries over the device mesh — stacked shard
         batches under shard_map with ICI reductions — the production
         equivalent of the reference's worker pool + mapReduce
         (executor.go:80-110, 2455).  ``dispatch_batch*``: cross-query
-        dynamic batching of device dispatch (docs/batching.md)."""
+        dynamic batching of device dispatch (docs/batching.md).
+        ``whole_query``: compile each read request into ONE pjit
+        program over the mesh (docs/whole-query.md)."""
         self.holder = holder
         self.cluster = cluster  # None = single-node
         self.stats = stats if stats is not None else StatsClient()
@@ -72,7 +76,9 @@ class API:
             holder, use_mesh=use_mesh, stats=self.stats,
             dispatch_batch=dispatch_batch,
             dispatch_batch_max=dispatch_batch_max,
-            dispatch_batch_window_us=dispatch_batch_window_us)
+            dispatch_batch_window_us=dispatch_batch_window_us,
+            whole_query=whole_query,
+            whole_query_fallback=whole_query_fallback)
         self._lock = make_rlock("api-schema")
 
     # -- state validation (api.go:119) -------------------------------------

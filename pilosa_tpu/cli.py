@@ -429,6 +429,10 @@ max-op-n = 10000
 # dispatch-batch = true         # fuse compatible in-flight queries
 # dispatch-batch-max = 32       # queries per fused device launch
 # dispatch-batch-window-us = 200  # max solo wait for batch company
+# whole-query pjit programs (docs/whole-query.md)
+# whole-query = true            # one compiled program per read request
+# whole-query-fallback = "legacy"  # or "error": raise instead of
+#                               # rerouting unsupported shapes
 # streaming ingest (docs/ingest.md)
 # ingest-flush-ms = 50     # group-commit window: one WAL frame + one gen
 #                          # bump per fragment per flush
@@ -489,6 +493,8 @@ def cmd_config(args) -> int:
     print(f"dispatch-batch = {str(cfg.dispatch_batch).lower()}")
     print(f"dispatch-batch-max = {cfg.dispatch_batch_max}")
     print(f"dispatch-batch-window-us = {cfg.dispatch_batch_window_us}")
+    print(f"whole-query = {str(cfg.whole_query).lower()}")
+    print(f"whole-query-fallback = {q(cfg.whole_query_fallback)}")
     print(f"device-budget-mb = {cfg.device_budget_mb}")
     print(f"compressed-resident = {str(cfg.compressed_resident).lower()}")
     print(f"compress-max-density = {cfg.compress_max_density}")

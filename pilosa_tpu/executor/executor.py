@@ -304,11 +304,102 @@ def _resolve_pendings(results):
     return out
 
 
+# -- whole-query host finalizers (docs/whole-query.md) ----------------------
+# Applied to the fetched device parts of one whole-query launch; each
+# mirrors the corresponding legacy per-stage reduction byte-for-byte.
+
+def _wq_sum_fin(hp, b, base):
+    total, cnt = 0, 0
+    for p in hp:
+        s, c_ = bsi.weighted_sum(np.asarray(p[b]))
+        total += s
+        cnt += c_
+    return ValCount(total + cnt * base, cnt)
+
+
+def _wq_topn_rank(mesh, hp, b, ids, n):
+    counts = mesh.merge_counts([p[b] for p in hp])
+    return rank_counts(counts, n or None, ids)
+
+
+def _wq_seg_result(hp, b, groups, empty, attrs):
+    segs: dict[int, np.ndarray] = {}
+    zero = np.zeros(SHARD_WORDS, dtype=np.uint32)
+    for shard_list, arr in zip(groups, hp):
+        for i, shard in enumerate(shard_list):
+            segs[shard] = arr[i, b]
+    for shard in empty:
+        segs[shard] = zero
+    return RowResult(segs, attrs=attrs)
+
+
+def _wq_minmax_fin(hp, groups, base, want_max):
+    acc = ValCount()
+    j = 0
+    for shard_list in groups:
+        bits, neg, cnt = hp[j], hp[j + 1], hp[j + 2]
+        j += 3
+        for i in range(len(shard_list)):
+            val, c = bsi.reconstruct_min_max(
+                np.asarray(bits[i]), int(neg[i]), int(cnt[i]))
+            vc = ValCount(val + base if c else 0, c)
+            acc = acc.larger(vc) if want_max else acc.smaller(vc)
+    return acc
+
+
+def _wq_minrow_fin(hp, want_max):
+    counts = np.asarray(hp[0][0], dtype=np.int64) if hp \
+        else np.zeros(0, dtype=np.int64)
+    nz = np.nonzero(counts)[0]
+    if nz.size == 0:
+        return ValCount(0, 0)
+    rid = int(nz[-1] if want_max else nz[0])
+    return ValCount(rid, int(counts[rid]))
+
+
+def _wq_rows_fin(hp, limit, previous):
+    row_ids: set[int] = set()
+    for p in hp:
+        row_ids.update(int(i) for i in np.nonzero(np.asarray(p[0]))[0])
+    out = sorted(row_ids)
+    if previous is not None:
+        out = [r for r in out if r > previous]
+    if limit is not None:
+        out = out[:limit]
+    return RowIdentifiers(rows=out)
+
+
+def _wq_groupby_fin(hp, combos, last_ids, last_field, prev_ids, limit):
+    acc = None
+    for p in hp:
+        a = np.asarray(p, dtype=np.int64)
+        acc = a.copy() if acc is None else acc_counts(acc, a)
+    out: list[GroupCount] = []
+    for ci, combo in enumerate(combos):
+        for rid in last_ids:
+            cnt = (int(acc[ci, rid]) if acc is not None
+                   and rid < acc.shape[1] else 0)
+            if cnt > 0:
+                group = [FieldRow(fn, ri) for fn, ri in combo]
+                group.append(FieldRow(last_field, rid))
+                out.append(GroupCount(group, cnt))
+    out.sort(key=lambda g: tuple(
+        (fr.field, fr.row_id) for fr in g.group))
+    if prev_ids is not None:
+        out = [g for g in out
+               if tuple(fr.row_id for fr in g.group) > prev_ids]
+    if limit is not None:
+        out = out[:limit]
+    return out
+
+
 class Executor:
     def __init__(self, holder, mesh=None, use_mesh: bool | None = None,
                  stats=None, dispatch_batch: bool = True,
                  dispatch_batch_max: int = 32,
-                 dispatch_batch_window_us: float = 200.0):
+                 dispatch_batch_window_us: float = 200.0,
+                 whole_query: bool = True,
+                 whole_query_fallback: str = "legacy"):
         """``mesh``: a jax Mesh to execute shard batches on (stacked
         shard_map execution with ICI reductions, parallel/mesh_exec.py).
         When None, per-shard dispatch is used.  ``use_mesh=True`` with no
@@ -318,7 +409,13 @@ class Executor:
         executor.go:295-336).  ``dispatch_batch*``: cross-query dynamic
         batching of device dispatch (parallel/batcher.py,
         docs/batching.md) — with it off, the batcher still fronts every
-        mesh dispatch but delegates directly."""
+        mesh dispatch but delegates directly.  ``whole_query``: compile
+        each read request into ONE pjit program over the mesh
+        (parallel/wholequery.py, docs/whole-query.md); off restores the
+        legacy per-stage dispatch exactly.  ``whole_query_fallback``:
+        "legacy" reroutes unsupported shapes to the per-stage path
+        (counted + logged); "error" raises instead — a debugging mode
+        that makes every silent slow path loud."""
         self.holder = holder
         self.compiler = PlanCompiler()
         from ..utils.stats import NopStatsClient
@@ -335,9 +432,19 @@ class Executor:
         self.mesh_exec = None
         self.batcher = None
         self.prepared = None
+        self.wholequery = None
+        self.whole_query = bool(whole_query)
+        self.whole_query_fallback = whole_query_fallback
+        # Server injects its Logger so wholequery.fallback events land in
+        # the server log; None (engine/bench standalone) stays silent.
+        self.logger = None
+        self.wq_requests = 0
+        self.wq_fallbacks = 0
+        self.wq_last_fallback = ""
         if mesh is not None or use_mesh:
             from ..parallel.batcher import DispatchBatcher
             from ..parallel.mesh_exec import MeshExecutor
+            from ..parallel.wholequery import WholeQueryRunner
             from .prepared import PreparedCache
             self.mesh_exec = MeshExecutor(mesh)
             self.batcher = DispatchBatcher(
@@ -345,6 +452,11 @@ class Executor:
                 max_batch=dispatch_batch_max,
                 window_us=dispatch_batch_window_us, stats=self.stats)
             self.prepared = PreparedCache(self)
+            # multiprocess meshes are statically outside the program's
+            # vocabulary — gating here (like the batcher's _use_ticket)
+            # keeps them off the per-request exception/fallback-log path
+            if not self.mesh_exec.multiprocess:
+                self.wholequery = WholeQueryRunner(self.mesh_exec)
 
     def close(self):
         if self.batcher is not None:
@@ -463,8 +575,20 @@ class Executor:
                             DEFAULT_BUDGET.evictions)
                 dnode.tags["calls"] = len(query.calls)
                 dnode.tags["shards"] = len(shards)
-            if self.mesh_exec is not None and len(query.calls) > 1 and \
-                    not any(c.name in WRITE_CALLS for c in query.calls):
+            read_only = not any(c.name in WRITE_CALLS
+                                for c in query.calls)
+            results = None
+            if self.wholequery is not None and self.whole_query and \
+                    read_only:
+                # whole-query path (docs/whole-query.md): the entire
+                # request compiles to ONE pjit program over the mesh;
+                # unsupported shapes fall back below, counted
+                results = self._try_whole_query(index_name, query.calls,
+                                                shards)
+            if results is not None:
+                pass
+            elif self.mesh_exec is not None and len(query.calls) > 1 and \
+                    read_only:
                 results = self._execute_calls_grouped(index_name,
                                                       query.calls, shards)
             else:
@@ -574,6 +698,455 @@ class Executor:
             if i not in batched:
                 results[i] = self._execute_call(index, c, shards)
         return results
+
+    # -- whole-query pjit programs (docs/whole-query.md) -------------------
+    # A read request lowers to a tuple of plan.ReduceNode reducers plus
+    # one params matrix per node, and the WHOLE request launches as one
+    # compiled program over the mesh (parallel/wholequery.py).  Shapes
+    # the program cannot express raise WholeQueryUnsupported and the
+    # request reroutes to the legacy per-stage dispatch with
+    # ``wholequery.fallback`` counted and a structured log event naming
+    # the unsupported node — no silent slow paths.
+
+    def _try_whole_query(self, index: str, calls, shards):
+        from ..parallel.wholequery import WholeQueryUnsupported
+        try:
+            results = self._wq_execute(index, calls, shards)
+        except WholeQueryUnsupported as e:
+            self._note_wq_fallback(index, e)
+            return None
+        self.wq_requests += 1
+        self.stats.count("wholequery.requests")
+        return results
+
+    def _note_wq_fallback(self, index: str, e):
+        self.wq_fallbacks += 1
+        self.wq_last_fallback = e.node if not e.detail \
+            else f"{e.node}: {e.detail}"
+        self.stats.count("wholequery.fallback")
+        log = self.logger
+        if log is not None:
+            try:
+                log.event("wholequery.fallback", index=index, node=e.node,
+                          detail=e.detail)
+            # lint: allow(swallowed-exception) — a stale/closed log
+            # stream costs a log line, never the query; the fallback is
+            # still counted in the stats above
+            except Exception:
+                pass
+        if self.whole_query_fallback == "error":
+            raise ExecutionError(
+                f"whole-query fallback disabled by the 'error' policy: "
+                f"{e.node}"
+                + (f": {e.detail}" if e.detail else "")) from e
+
+    def _wq_dispatch(self, index: str, shards, program, mats):
+        """One program launch through the dispatch batcher (concurrent
+        same-shape requests fuse along the params batch axis)."""
+        return self.batcher.whole_query(self.wholequery, program, mats,
+                                        self.holder, index, shards)
+
+    @staticmethod
+    def _wq_chunk_guard(mat: np.ndarray, n_split: int):
+        """A params batch needing more than one dispatch chunk (device
+        gather-temp budget) stays on the legacy chunked path.  Pure
+        arithmetic — the same sizing as _batch_chunks, without
+        materializing a padded chunk just to count them."""
+        from ..parallel.wholequery import WholeQueryUnsupported
+        B, P = mat.shape
+        if n_split <= 0:
+            return  # broadcast pass: always one chunk
+        weight = max(1, P) * n_split * SHARD_WORDS * 4
+        chunk = max(BATCH_CHUNK_MIN,
+                    min(BATCH_CHUNK_MAX, BATCH_TEMP_BYTES // weight))
+        chunk = 1 << (chunk.bit_length() - 1)
+        if B > chunk:
+            raise WholeQueryUnsupported("batch-chunks", f"B={B}")
+
+    def _wq_run_batched(self, index: str, shards, groups, results):
+        """Whole-query dispatch of standard batched call groups —
+        (kind, slotted, params_mat, call_idxs, extra) with kind in
+        count/sum/topn, the _run_batched_groups contract — as ONE
+        program launch.  Used by the prepared-statement replay so a
+        whole template is one launch; raises WholeQueryUnsupported for
+        shapes the program can't take (caller falls back)."""
+        from ..core import VIEW_STANDARD as _STD
+        from .plan import ReduceNode
+        groups = list(groups)
+        if not groups:
+            return
+        per_dev = self.mesh_exec.stacked_per_device(max(len(shards), 1))
+        nodes, mats = [], []
+        for kind, slotted, params_mat, call_idxs, extra in groups:
+            n_split = per_dev if (kind == "count" or slotted is not None) \
+                else 0
+            self._wq_chunk_guard(params_mat, n_split)
+            if kind == "count":
+                nodes.append(ReduceNode("count", slotted))
+            elif kind == "sum":
+                nodes.append(ReduceNode(
+                    "bsi_sum", slotted, (extra["field"], extra["view"])))
+            else:  # topn
+                nodes.append(ReduceNode(
+                    "row_counts", slotted,
+                    (extra["field"], extra.get("view", _STD))))
+            mats.append(params_mat)
+        out = self._wq_dispatch(index, shards, tuple(nodes), mats)
+        mesh = self.mesh_exec
+        for gi, (kind, slotted, params_mat, call_idxs, extra) \
+                in enumerate(groups):
+            parts = out.parts[gi]
+            if kind == "count":
+                grp = _PendingGroup.counts(parts, call_idxs)
+                for i in call_idxs:
+                    results[i] = grp
+            elif kind == "sum":
+                base = extra["base"]
+                for b, i in enumerate(call_idxs):
+                    results[i] = _Pending(
+                        parts, lambda hp, b=b, base=base:
+                        _wq_sum_fin(hp, b, base))
+            else:
+                ids_n = extra["ids_n"]
+                for b, i in enumerate(call_idxs):
+                    ids, n = ids_n[b]
+                    results[i] = _Pending(
+                        parts, lambda hp, b=b, ids=ids, n=n, mesh=mesh:
+                        _wq_topn_rank(mesh, hp, b, ids, n))
+
+    def _wq_execute(self, index: str, calls, shards):
+        """Lower every call of a read request to reducer nodes, launch
+        the whole program once, and wire _Pending results (resolved by
+        the caller's single fetch).  Raises WholeQueryUnsupported for
+        anything outside the program's fallback matrix
+        (docs/whole-query.md); real validation errors raise exactly as
+        the legacy path would."""
+        from .plan import ReduceNode
+        idx = self.holder.index(index)
+        if idx is None:
+            raise ExecutionError(f"index not found: {index}")
+        descs = [self._wq_desc(index, c, shards) for c in calls]
+        results: list = [None] * len(calls)
+        units: list[dict] = []
+        by_gkey: dict = {}
+        for i, d in enumerate(descs):
+            if d["kind"] == "const":
+                results[i] = d["result"]
+                continue
+            gk = d.get("gkey")
+            u = by_gkey.get(gk) if gk is not None else None
+            if u is None:
+                u = {"kind": d["kind"], "descs": [], "idxs": []}
+                if gk is not None:
+                    by_gkey[gk] = u
+                units.append(u)
+            u["descs"].append(d)
+            u["idxs"].append(i)
+        if not units:
+            return results
+
+        per_dev = self.mesh_exec.stacked_per_device(max(len(shards), 1))
+        nodes, mats, unit_nodes = [], [], []
+        for u in units:
+            kind, ds = u["kind"], u["descs"]
+            lo = len(nodes)
+            d0 = ds[0]
+            if kind in ("count", "segments"):
+                mat = np.stack([d["params"] for d in ds])
+                self._wq_chunk_guard(mat, per_dev)
+                nodes.append(ReduceNode(kind, d0["slotted"]))
+                mats.append(mat)
+            elif kind == "sum":
+                mat = np.stack([d["params"] for d in ds])
+                self._wq_chunk_guard(
+                    mat, per_dev if d0["slotted"] is not None else 0)
+                nodes.append(ReduceNode("bsi_sum", d0["slotted"],
+                                        (d0["field"], d0["view"])))
+                mats.append(mat)
+            elif kind == "topn":
+                mat = np.stack([d["params"] for d in ds])
+                self._wq_chunk_guard(
+                    mat, per_dev if d0["slotted"] is not None else 0)
+                nodes.append(ReduceNode("row_counts", d0["slotted"],
+                                        (d0["field"], VIEW_STANDARD)))
+                mats.append(mat)
+                if d0["tan"]:
+                    # tanimoto rides two extra reducers in the SAME
+                    # program: unfiltered row totals + the source count
+                    nodes.append(ReduceNode(
+                        "row_counts", None, (d0["field"], VIEW_STANDARD)))
+                    mats.append(np.zeros((1, 0), dtype=np.int32))
+                    nodes.append(ReduceNode("count", d0["slotted"]))
+                    mats.append(mat)
+            elif kind == "minmax":
+                nodes.append(ReduceNode(
+                    "bsi_minmax", d0["slotted"],
+                    (d0["field"], d0["view"]),
+                    ("max" if d0["want_max"] else "min",)))
+                mats.append(np.asarray(d0["params"],
+                                       dtype=np.int32).reshape(1, -1))
+            elif kind == "minrow":
+                nodes.append(ReduceNode(
+                    "row_counts", None, (d0["field"], VIEW_STANDARD)))
+                mats.append(np.zeros((1, 0), dtype=np.int32))
+            elif kind == "rows":
+                for vname in d0["views"]:
+                    nodes.append(ReduceNode(
+                        "row_counts", None, (d0["field"], vname)))
+                    mats.append(np.zeros((1, 0), dtype=np.int32))
+            else:  # groupby
+                nodes.append(ReduceNode(
+                    "group_counts", d0["slotted"],
+                    (d0["last_field"], VIEW_STANDARD),
+                    tuple(d0["prefix_keys"]) + (d0["pad_c"],)))
+                mats.append((d0["rids"], d0["params"]))
+            unit_nodes.append((lo, len(nodes)))
+
+        out = self._wq_dispatch(index, shards, tuple(nodes), mats)
+        for u, (lo, hi) in zip(units, unit_nodes):
+            self._wq_wire(u, out, lo, hi, results)
+        return results
+
+    def _wq_wire(self, unit, out, lo, hi, results):
+        """Attach _Pending finalizers for one unit's calls over its
+        nodes' device parts — each finalizer mirrors the legacy path's
+        host reduction exactly (results stay byte-identical)."""
+        kind, ds, idxs = unit["kind"], unit["descs"], unit["idxs"]
+        mesh = self.mesh_exec
+        if kind == "count":
+            grp = _PendingGroup.counts(out.parts[lo], idxs)
+            for i in idxs:
+                results[i] = grp
+            return
+        if kind == "segments":
+            parts, meta = out.parts[lo], out.meta[lo]
+            for b, i in enumerate(idxs):
+                attrs = ds[b].get("attrs")
+                results[i] = _Pending(
+                    parts, lambda hp, b=b, groups=meta["groups"],
+                    empty=meta["empty"], attrs=attrs:
+                    _wq_seg_result(hp, b, groups, empty, attrs))
+            return
+        if kind == "sum":
+            parts = out.parts[lo]
+            for b, i in enumerate(idxs):
+                base = ds[b]["base"]
+                results[i] = _Pending(
+                    parts, lambda hp, b=b, base=base:
+                    _wq_sum_fin(hp, b, base))
+            return
+        if kind == "topn":
+            d0 = ds[0]
+            parts = [p for j in range(lo, hi) for p in out.parts[j]]
+            k = len(out.parts[lo])
+            ku = len(out.parts[lo + 1]) if d0["tan"] else 0
+            f = d0["f"]
+            for b, i in enumerate(idxs):
+                d = ds[b]
+                results[i] = _Pending(
+                    parts,
+                    lambda hp, b=b, ids=d["ids"], n=d["n"], k=k, ku=ku,
+                    tan=d["tan"], an=d["attr_name"], av=d["attr_values"],
+                    f=f, mesh=mesh:
+                    self._topn_finalize(
+                        mesh.merge_counts([p[b] for p in hp[:k]]),
+                        mesh.merge_counts([p[0] for p in hp[k:k + ku]])
+                        if tan else None,
+                        sum(int(p[0]) for p in hp[k + ku:]) if tan
+                        else 0,
+                        ids, n, tan, an, av, f))
+            return
+        if kind == "minmax":
+            d0 = ds[0]
+            results[idxs[0]] = _Pending(
+                out.parts[lo],
+                lambda hp, groups=out.meta[lo]["groups"],
+                base=d0["base"], want_max=d0["want_max"]:
+                _wq_minmax_fin(hp, groups, base, want_max))
+            return
+        if kind == "minrow":
+            results[idxs[0]] = _Pending(
+                out.parts[lo],
+                lambda hp, want_max=ds[0]["want_max"]:
+                _wq_minrow_fin(hp, want_max))
+            return
+        if kind == "rows":
+            d0 = ds[0]
+            parts = [p for j in range(lo, hi) for p in out.parts[j]]
+            results[idxs[0]] = _Pending(
+                parts, lambda hp, limit=d0["limit"],
+                previous=d0["previous"]: _wq_rows_fin(hp, limit,
+                                                      previous))
+            return
+        # groupby
+        d0 = ds[0]
+        results[idxs[0]] = _Pending(
+            out.parts[lo],
+            lambda hp, combos=d0["combos"], last_ids=d0["last_ids"],
+            last_field=d0["last_field"], prev_ids=d0["prev_ids"],
+            limit=d0["limit"]:
+            _wq_groupby_fin(hp, combos, last_ids, last_field, prev_ids,
+                            limit))
+
+    def _wq_desc(self, index: str, c: Call, shards) -> dict:
+        """Lower one call to a whole-query unit descriptor, running the
+        same validation (and raising the same errors) as the legacy
+        per-call path.  Raises WholeQueryUnsupported for call shapes
+        outside the program's vocabulary."""
+        from ..parallel.wholequery import WholeQueryUnsupported
+        name = c.name
+        if name == "Count":
+            if len(c.children) != 1:
+                raise ExecutionError("Count() requires one input")
+            slotted, params = parametrize(
+                self._resolve(index, c.children[0]))
+            return {"kind": "count", "gkey": ("count", repr(slotted)),
+                    "slotted": slotted, "params": params}
+        if name == "Sum":
+            f = self._bsi_field(index, c)
+            fp = self._filter_plan(index, c)
+            slotted, params = (None, self._EMPTY_PARAMS) if fp is None \
+                else parametrize(fp)
+            return {"kind": "sum", "gkey": ("sum", f.name, repr(slotted)),
+                    "slotted": slotted, "params": params, "field": f.name,
+                    "view": f.bsi_view_name(), "base": f.options.base}
+        if name in ("Min", "Max"):
+            f = self._bsi_field(index, c)
+            fp = self._filter_plan(index, c)
+            slotted, params = (None, self._EMPTY_PARAMS) if fp is None \
+                else parametrize(fp)
+            return {"kind": "minmax", "gkey": None, "slotted": slotted,
+                    "params": params, "field": f.name,
+                    "view": f.bsi_view_name(), "base": f.options.base,
+                    "want_max": name == "Max"}
+        if name in ("MinRow", "MaxRow"):
+            field_name, ok = c.string_arg("field")
+            if not ok:
+                raise ExecutionError(f"{c.name}(): field required")
+            if self.holder.field(index, field_name) is None:
+                raise ExecutionError(f"field not found: {field_name}")
+            return {"kind": "minrow", "gkey": None, "field": field_name,
+                    "want_max": name == "MaxRow"}
+        if name == "TopN":
+            return self._wq_desc_topn(index, c, shards)
+        if name == "Rows":
+            return self._wq_desc_rows(index, c)
+        if name == "GroupBy":
+            return self._wq_desc_group_by(index, c)
+        if name in BITMAP_CALLS:
+            plan = self._resolve(index, c)
+            slotted, params = parametrize(plan)
+            attrs = None
+            if c.name in ("Row", "Range"):
+                fa = c.field_arg()
+                if fa is not None and isinstance(fa[1], int) \
+                        and not isinstance(fa[1], bool):
+                    f = self.holder.field(index, fa[0])
+                    if f is not None:
+                        attrs = f.row_attrs.attrs(fa[1]) or None
+            return {"kind": "segments",
+                    "gkey": ("segments", repr(slotted)),
+                    "slotted": slotted, "params": params, "attrs": attrs}
+        if name == "Options":
+            raise WholeQueryUnsupported("options",
+                                        "per-call shard overrides")
+        raise ExecutionError(f"unknown call: {name}")
+
+    def _wq_desc_topn(self, index: str, c: Call, shards) -> dict:
+        field_name, ok = c.string_arg("_field")
+        if not ok:
+            raise ExecutionError("TopN() requires a field")
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        n, _ = c.uint_arg("n")
+        ids = c.args.get("ids")
+        tan_thresh, attr_name, attr_values = topn_extras(c)
+        if not c.children and ids is None and tan_thresh is None \
+                and attr_name is None \
+                and f.options.cache_type in ("ranked", "lru"):
+            from ..cache.rank import topn_from_rank
+            pairs = topn_from_rank(f, shards, n, stats=self.stats)
+            if pairs is not None:
+                return {"kind": "const", "result": pairs}
+        fp = self._filter_plan(index, c)
+        slotted, params = (None, self._EMPTY_PARAMS) if fp is None \
+            else parametrize(fp)
+        extras = tan_thresh is not None or attr_name is not None
+        return {"kind": "topn",
+                "gkey": None if extras
+                else ("topn", field_name, repr(slotted)),
+                "slotted": slotted, "params": params,
+                "field": field_name, "ids": ids, "n": n,
+                "tan": tan_thresh, "attr_name": attr_name,
+                "attr_values": attr_values, "f": f}
+
+    def _wq_desc_rows(self, index: str, c: Call) -> dict:
+        from ..parallel.wholequery import WholeQueryUnsupported
+        field_name, ok = c.string_arg("_field")
+        if not ok:
+            raise ExecutionError("Rows() requires a field")
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        if c.args.get("column") is not None:
+            # a column probe reads one bit per row — the per-shard path
+            # owns it (no reduction to express)
+            raise WholeQueryUnsupported("rows-column")
+        views = [VIEW_STANDARD]
+        from_arg, to_arg = c.args.get("from"), c.args.get("to")
+        if from_arg or to_arg:
+            quantum = f.options.time_quantum
+            if not quantum:
+                raise ExecutionError(
+                    f"field {field_name!r} has no time quantum")
+            from_time = tq.parse_time(from_arg) if from_arg \
+                else datetime(1, 1, 1)
+            to_time = tq.parse_time(to_arg) if to_arg \
+                else datetime(9999, 1, 1)
+            views = tq.views_by_time_range(VIEW_STANDARD, from_time,
+                                           to_time, quantum)
+        return {"kind": "rows", "gkey": None, "field": field_name,
+                "views": views, "limit": c.args.get("limit"),
+                "previous": c.args.get("previous")}
+
+    def _wq_desc_group_by(self, index: str, c: Call) -> dict:
+        from ..parallel.mesh_exec import MeshExecutor
+        from ..parallel.wholequery import WholeQueryUnsupported
+        names, rows_calls, filt_call, limit = self._group_by_parse(index,
+                                                                   c)
+        fields = self._group_by_grid(index, names, rows_calls)
+        if fields is None:
+            raise WholeQueryUnsupported(
+                "group_counts", "children need Rows execution or the "
+                                "grid bounds failed")
+        prev_ids = self._group_by_previous(c, fields)
+        filter_plan = (self._resolve(index, filt_call)
+                       if filt_call is not None else None)
+        slotted, params = (None, self._EMPTY_PARAMS) \
+            if filter_plan is None else parametrize(filter_plan)
+        prefix_fields = fields[:-1]
+        last_field, last_ids = fields[-1]
+        combos: list[tuple] = [()]
+        for fname, ids in prefix_fields:
+            combos = [cb + ((fname, rid),) for cb in combos
+                      for rid in ids]
+        if not combos or not last_ids:
+            return {"kind": "const", "result": []}
+        if len(combos) > MeshExecutor.GROUP_CHUNK:
+            raise WholeQueryUnsupported(
+                "group_counts",
+                f"{len(combos)} prefix combos exceed one chunk")
+        rids = np.asarray([[rid for _, rid in cb] for cb in combos],
+                          dtype=np.int32).reshape(len(combos),
+                                                  len(prefix_fields))
+        pad_c = 1 << max(0, len(combos) - 1).bit_length()
+        return {"kind": "groupby", "gkey": None, "slotted": slotted,
+                "params": params, "rids": rids, "pad_c": pad_c,
+                "prefix_keys": [(fname, VIEW_STANDARD)
+                                for fname, _ in prefix_fields],
+                "last_field": last_field, "last_ids": last_ids,
+                "combos": combos, "prev_ids": prev_ids, "limit": limit}
 
     # -- dispatch (executor.go:274 executeCall) ----------------------------
 
@@ -959,8 +1532,10 @@ class Executor:
 
     # -- GroupBy (executor.go:1068 executeGroupBy) -------------------------
 
-    def _execute_group_by(self, index: str, c: Call,
-                          shards) -> list[GroupCount]:
+    def _group_by_parse(self, index: str, c: Call):
+        """(names, rows_calls, filt_call, limit) with the reference's
+        argument validation — shared by the legacy path and the
+        whole-query lowering (_wq_desc_group_by)."""
         if not c.children:
             raise ExecutionError("GroupBy requires at least one Rows() child")
         limit = c.args.get("limit")
@@ -973,65 +1548,81 @@ class Executor:
                 filt_call = ch
         if not rows_calls:
             raise ExecutionError("GroupBy requires Rows() children")
-
         names = []
         for rc in rows_calls:
             fname, ok = rc.string_arg("_field")
             if not ok:
                 raise ExecutionError("Rows() requires a field")
             names.append(fname)
+        return names, rows_calls, filt_call, limit
 
+    def _group_by_grid(self, index: str, names, rows_calls):
+        """Row-id grid fields when every child is a plain Rows(field)
+        and the grid bounds hold; None otherwise (the caller executes
+        Rows).  Plain Rows() children take a row-id GRID instead of
+        executing Rows first: every (field, row<=max_row) combo is
+        counted and zero-count groups drop out, which is the same
+        answer without the per-child blocking device round trips (the
+        odometer seeds of executor.go:3058, folded into the combo
+        dispatch).  Only the PREFIX fields' product is dispatched (the
+        last field rides each dispatch's per-row count vector), so the
+        grid bounds are: prefix combos per wave (chunked to GROUP_CHUNK
+        per executable call, all async) and the total combo count
+        (which sizes the count fetch: total x 4 bytes).  The r4 cap of
+        4096 TOTAL combos fell back to blocking per-child Rows round
+        trips for e.g. a 128x128 two-field GroupBy whose dispatch cost
+        is actually one 128-combo wave."""
+        if not all(set(rc.args) == {"_field"} for rc in rows_calls):
+            return None
+        caps = []
+        for fname in names:
+            f = self.holder.field(index, fname)
+            if f is None:
+                raise ExecutionError(f"field not found: {fname}")
+            v = f.view(VIEW_STANDARD)
+            cap = 0 if v is None else max(
+                (fr.max_row_id() + 1 for fr in v.fragments.values()
+                 if fr.host_bytes()), default=0)
+            caps.append(cap)
+        total = 1
+        for c_ in caps:
+            total *= c_
+        prefix_total = 1
+        for c_ in caps[:-1]:
+            prefix_total *= c_
+        if 0 < total <= self.GROUP_GRID_MAX and \
+                prefix_total <= self.GROUP_GRID_PREFIX_MAX:
+            return [(fname, list(range(c_)))
+                    for fname, c_ in zip(names, caps)]
+        return None
+
+    @staticmethod
+    def _group_by_previous(c: Call, fields):
+        """previous=[row per Rows child]: resume pagination strictly
+        after that group (executor.go:1403, :3058 groupByIterator
+        seek)."""
+        previous = c.args.get("previous")
+        if previous is None:
+            return None
+        if not isinstance(previous, list) or \
+                len(previous) != len(fields):
+            raise ExecutionError(
+                "GroupBy previous= must list one row per Rows child")
+        return tuple(int(p) for p in previous)
+
+    def _execute_group_by(self, index: str, c: Call,
+                          shards) -> list[GroupCount]:
+        names, rows_calls, filt_call, limit = self._group_by_parse(index,
+                                                                   c)
         fields = []
-        # Plain Rows() children on the mesh path take a row-id GRID
-        # instead of executing Rows first: every (field, row<=max_row)
-        # combo is counted and zero-count groups drop out, which is the
-        # same answer without the per-child blocking device round trips
-        # (the odometer seeds of executor.go:3058, folded into the combo
-        # dispatch).  Only the PREFIX fields' product is dispatched (the
-        # last field rides each dispatch's per-row count vector), so the
-        # grid bounds are: prefix combos per wave (chunked to GROUP_CHUNK
-        # per executable call, all async) and the total combo count
-        # (which sizes the count fetch: total x 4 bytes).  The r4 cap of
-        # 4096 TOTAL combos fell back to blocking per-child Rows round
-        # trips for e.g. a 128x128 two-field GroupBy whose dispatch cost
-        # is actually one 128-combo wave.
-        if self.mesh_exec is not None and \
-                all(set(rc.args) == {"_field"} for rc in rows_calls):
-            caps = []
-            for fname in names:
-                f = self.holder.field(index, fname)
-                if f is None:
-                    raise ExecutionError(f"field not found: {fname}")
-                v = f.view(VIEW_STANDARD)
-                cap = 0 if v is None else max(
-                    (fr.max_row_id() + 1 for fr in v.fragments.values()
-                     if fr.host_bytes()), default=0)
-                caps.append(cap)
-            total = 1
-            for c_ in caps:
-                total *= c_
-            prefix_total = 1
-            for c_ in caps[:-1]:
-                prefix_total *= c_
-            if 0 < total <= self.GROUP_GRID_MAX and \
-                    prefix_total <= self.GROUP_GRID_PREFIX_MAX:
-                fields = [(fname, list(range(c_)))
-                          for fname, c_ in zip(names, caps)]
+        if self.mesh_exec is not None:
+            fields = self._group_by_grid(index, names, rows_calls) or []
         if not fields:
             for fname, rc in zip(names, rows_calls):
                 ids = self._execute_rows(index, rc, shards).rows
                 fields.append((fname, ids))
 
-        # previous=[row per Rows child]: resume pagination strictly after
-        # that group (executor.go:1403, :3058 groupByIterator seek)
-        previous = c.args.get("previous")
-        prev_ids = None
-        if previous is not None:
-            if not isinstance(previous, list) or \
-                    len(previous) != len(fields):
-                raise ExecutionError(
-                    "GroupBy previous= must list one row per Rows child")
-            prev_ids = tuple(int(p) for p in previous)
+        prev_ids = self._group_by_previous(c, fields)
 
         def _paginate(groups_out):
             if prev_ids is not None:

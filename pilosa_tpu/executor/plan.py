@@ -95,6 +95,35 @@ class Slot:
         return f"${self.idx}:{self.sign}:{self.width}"
 
 
+@dataclass(frozen=True)
+class ReduceNode:
+    """Whole-query reducer node (docs/whole-query.md).
+
+    A parsed PQL request compiles to ONE pjit-ed XLA program over the
+    global mesh-sharded bitmap arrays (parallel/wholequery.py); each
+    call (or batch of same-shape calls) becomes one ReduceNode whose
+    reduction — Count popcount-sums, TopN row-count accumulations, BSI
+    slice counts, GroupBy combo grids — happens INSIDE the program as a
+    partitioned reduction over the shard axis instead of host-assembled
+    per-shard segments.  ``repr`` of the node tuple is the program's
+    shape cache key (the same convention as the per-shard plan IR
+    above): params ride as runtime arguments, so distinct literals
+    share one compiled program.
+
+    kind: count | segments | row_counts | bsi_sum | bsi_minmax
+          | group_counts
+    plan: the slotted bitmap plan (count/segments) or the slotted
+          filter plan / None (field reducers)
+    primary: (field, view) the reducer reads, () for plan reducers
+    extra: structural extras — ("max",)/("min",) for bsi_minmax,
+          (prefix_keys..., pad_c) for group_counts
+    """
+    kind: str
+    plan: Any = None
+    primary: tuple = ()
+    extra: tuple = ()
+
+
 def parametrize(plan, trace: bool = False):
     """Replace literal row ids / BSI values with Slots; returns
     (slotted_plan, params int32[P]).  repr(slotted_plan) is the shape cache

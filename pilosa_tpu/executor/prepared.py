@@ -192,10 +192,13 @@ class PreparedEntry:
 
     def run(self, ex, index: str, values: np.ndarray, shards):
         """Dispatch all groups, then resolve with one device fetch.
-        Returns the results list, in call order.  Dispatch rides the
-        cross-query batcher (parallel/batcher.py): concurrent requests
-        replaying the same template fuse into one device launch — the
-        serving hot path the dynamic batching exists for."""
+        Returns the results list, in call order.  With whole-query on
+        (docs/whole-query.md) the WHOLE template replays as one pjit
+        program launch; otherwise (or on an unsupported shape) dispatch
+        rides the cross-query batcher (parallel/batcher.py) per group.
+        Either way concurrent requests replaying the same template fuse
+        into one device launch — the serving hot path the dynamic
+        batching exists for."""
         from .executor import _resolve_pendings, _run_batched_groups
 
         holder = ex.holder
@@ -203,11 +206,20 @@ class PreparedEntry:
             idx = holder.index(index)
             shards = sorted(idx.available_shards())
         results: list = [None] * self.n_calls
-        _run_batched_groups(
-            ex.batcher, holder, index, shards,
-            ((g.kind, g.slotted, g.build_params(values), g.call_idxs,
-              g.extra) for g in self.groups),
-            results)
+        groups = [(g.kind, g.slotted, g.build_params(values),
+                   g.call_idxs, g.extra) for g in self.groups]
+        if ex.wholequery is not None and ex.whole_query:
+            from ..parallel.wholequery import WholeQueryUnsupported
+            try:
+                ex._wq_run_batched(index, shards, groups, results)
+                ex.wq_requests += 1
+                ex.stats.count("wholequery.requests")
+                return _resolve_pendings(results)
+            except WholeQueryUnsupported as e:
+                ex._note_wq_fallback(index, e)
+                results = [None] * self.n_calls
+        _run_batched_groups(ex.batcher, holder, index, shards, groups,
+                            results)
         return _resolve_pendings(results)
 
 

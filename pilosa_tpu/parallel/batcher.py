@@ -42,6 +42,7 @@ collectives.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from concurrent.futures import Future
@@ -281,6 +282,36 @@ class DispatchBatcher:
     def group_counts_batch_async(self, *args, **kwargs):
         return self.mesh.group_counts_batch_async(*args, **kwargs)
 
+    # -- whole-query programs (docs/whole-query.md) ------------------------
+
+    _wq_nofuse = itertools.count()
+
+    def whole_query(self, runner, program, mats, holder, index, shards):
+        """One whole-query program launch.  Concurrent requests whose
+        programs share a shape (same reducer tuple, index, shard set)
+        fuse by concatenating each node's params matrix along the batch
+        axis — the batched parameter axis rides the SAME compiled
+        program, so the fused-launch economics of the reducer tickets
+        carry over to whole requests.  Programs with non-batchable
+        nodes (bsi_minmax, group_counts) launch un-fused."""
+        if not self._use_ticket():
+            return runner.run(program, mats, holder, index, shards)
+        key = ("wholequery", repr(program), index, tuple(shards),
+               id(holder))
+        if not runner.fusible(program):
+            # unique key: never coalesced with another ticket
+            key = key + ("nofuse", next(self._wq_nofuse))
+        rows = sum(m[0].shape[0] if isinstance(m, tuple) else m.shape[0]
+                   for m in mats)
+        out = self._submit(
+            "wholequery", key,
+            np.zeros((max(rows, 1), 0), dtype=np.int32), False,
+            {"runner": runner, "program": program, "mats": mats,
+             "holder": holder, "index": index, "shards": list(shards)})
+        if out is None:  # closed mid-flight: direct
+            return runner.run(program, mats, holder, index, shards)
+        return out
+
     # -- matrix surface (_run_batched_groups / prepared replay) ------------
 
     def count_batch(self, slotted, params_mat, holder, index, shards,
@@ -451,6 +482,9 @@ class DispatchBatcher:
         their batch executable directly."""
         p = t.payload
         mesh = self.mesh
+        if t.kind == "wholequery":
+            return p["runner"].run(p["program"], p["mats"], p["holder"],
+                                   p["index"], p["shards"])
         if t.scalar:
             if t.kind == "count":
                 return mesh.count_async(p["plan"], p["holder"], p["index"],
@@ -510,7 +544,72 @@ class DispatchBatcher:
                      "paddedRows": padded_rows},
                     collect=t.trace.collect)
 
+    def _launch_fused_whole(self, tickets):
+        """Fuse same-shape whole-query programs: concatenate each
+        node's params matrix along the batch axis and launch the shared
+        compiled program ONCE; per-ticket results are batch-axis slices
+        (WholeOut.slice_batch).  Fusibility (batch-kind nodes only) was
+        decided at ticket creation via the key."""
+        from .wholequery import WholeQueryUnsupported
+        p0 = tickets[0].payload
+        runner = p0["runner"]
+        program = p0["program"]
+        t_launch0 = time.perf_counter()
+        try:
+            # no pre-schedule here: runner.run's precheck walks the
+            # shard schedule exactly once; an over-budget working set
+            # raises WholeQueryUnsupported into every waiter below and
+            # the executors reroute to the legacy streaming path
+            n_nodes = len(program)
+            node_mats, node_lo = [], []
+            for ni in range(n_nodes):
+                mats_n = [t.payload["mats"][ni] for t in tickets]
+                lows, lo = [], 0
+                for m in mats_n:
+                    lows.append(lo)
+                    lo += m.shape[0]
+                node_lo.append(lows)
+                node_mats.append(np.concatenate(mats_n)
+                                 if len(mats_n) > 1 else mats_n[0])
+            B = sum(m.shape[0] for m in node_mats)
+            pad_total = sum(
+                (1 << max(0, m.shape[0] - 1).bit_length()) - m.shape[0]
+                for m in node_mats)
+            # no FAULTS.hit here: runner.run gates the launch (one
+            # mesh.slice hit per launch, matching the direct path)
+            queue_s = max(time.monotonic()
+                          - min(t.enq for t in tickets), 0.0)
+            ltok = devobs.set_launch_ctx(queue_s=queue_s,
+                                         tickets=len(tickets), rows=B)
+            try:
+                out = runner.run(program, node_mats, p0["holder"],
+                                 p0["index"], p0["shards"])
+            finally:
+                devobs.reset_launch_ctx(ltok)
+            self._note_fused(tickets, time.perf_counter() - t_launch0,
+                             batch_rows=B, padded_rows=pad_total)
+            with _DISPATCH_LOCK:
+                for ti, t in enumerate(tickets):
+                    t.future.set_result(out.slice_batch(
+                        program,
+                        [node_lo[ni][ti] for ni in range(n_nodes)],
+                        [t.payload["mats"][ni].shape[0]
+                         for ni in range(n_nodes)]))
+        except BaseException as e:
+            if isinstance(e, WholeQueryUnsupported) and \
+                    e.node == "streamed-working-set":
+                self.stream_fallbacks += 1
+                self.stats.count("dispatch.launch.stream_fallback")
+            self._fail_all(tickets, e if isinstance(e, Exception)
+                           else RuntimeError(repr(e)))
+            return
+        self.fused_launches += 1
+        self.stats.count("dispatch.launch.fused")
+        self.stats.count("dispatch.fused_queries", len(tickets))
+
     def _launch_fused(self, kind, tickets):
+        if kind == "wholequery":
+            return self._launch_fused_whole(tickets)
         p0 = tickets[0].payload
         mesh = self.mesh
         t_launch0 = time.perf_counter()

@@ -1,0 +1,561 @@
+"""Whole-query pjit programs: ONE XLA computation per PQL request.
+
+PAPER.md's stated design is that "PQL calls (Intersect/Union/TopN/
+GroupBy/Count) compile to a single XLA computation per request" — the
+pjit/PartitionSpec pattern of SNIPPETS.md [1][3].  The legacy executor
+dispatches one shard_map executable per reducer stage per shape group,
+with a Python hop between every PQL stage; this module compiles the
+ENTIRE parsed request — every call, every shape group, the PR 7
+container decode, and the cross-shard reductions — into one jitted
+program over global mesh-sharded arrays (docs/whole-query.md).
+
+Mechanics: the executor lowers a read query to a tuple of
+``plan.ReduceNode``s (Count popcount-sums, TopN/Rows row-count
+accumulations, BSI slice counts, Min/Max extremum scans, GroupBy combo
+grids, raw segments) plus one params matrix per node.  ``run`` stacks
+the request's fragment inputs with the SAME residency machinery the
+legacy path uses — ``MeshExecutor._placed_groups`` with its stack
+cache, device-budget accounting, compressed staging, and ingest
+overlays all compose unchanged — and places them sharded over the
+named ``shards`` mesh axis (``PartitionSpec(SHARD_AXIS)``); params ride
+replicated (``P()``).  The whole program is ONE ``shard_map`` over
+that axis: the body decodes compressed stacks once per shape group,
+evaluates every node's per-shard contribution in one vmapped pass over
+the device-local block, and reduces IN PROGRAM — local sums +
+``lax.psum`` over the shard axis replace the per-shard ``segments()``
+the legacy path assembled host-side.  (Manual partitioning on purpose:
+auto-partitioned jit replicates the vmapped row-gathers — a 4096-wide
+Count batch allocated a 279 GB gather temp — while shard_map pins the
+per-device shapes the ``BATCH_TEMP_BYTES`` chunk budget assumes.)  One
+launch per request — the launch ledger (utils/devobs.py) records it as
+kind ``wholequery``.
+
+Shapes the program cannot express raise ``WholeQueryUnsupported`` and
+the executor reroutes to the legacy per-stage dispatch, counting
+``wholequery.fallback`` (docs/whole-query.md has the fallback matrix):
+multi-process meshes (per-process staging must stay deterministic),
+over-budget working sets (the streaming slice planner owns those),
+params batches beyond one dispatch chunk, and GroupBy grids beyond one
+combo chunk.
+
+Batching (docs/batching.md): concurrent requests whose programs share a
+shape fuse in the dispatch batcher by concatenating each node's params
+matrix along the batch axis — the batched parameter axis rides the same
+compiled program, so the PR 4 fused-launch economics carry over
+unchanged.
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core import SHARD_WORDS
+from ..executor.plan import eval_plan, plan_inputs
+from ..ops import bsi
+from ..utils import devobs as _devobs
+from ..utils import profile as qprof
+from ..utils.deadline import check_current
+from ..utils.faults import FAULTS
+from .mesh_exec import _DISPATCH_LOCK, _SM_CHECK_KW, _flatten_present, \
+    _shard_map, _sig_rows, _unpack_frags, SHARD_AXIS
+
+
+class WholeQueryUnsupported(Exception):
+    """A request (or runtime shape) the whole-query program cannot
+    express.  The executor counts ``wholequery.fallback``, emits a
+    structured log event naming the unsupported node, and reroutes to
+    the legacy per-stage dispatch — never a silent slow path."""
+
+    def __init__(self, node: str, detail: str = ""):
+        super().__init__(f"{node}: {detail}" if detail else node)
+        self.node = node
+        self.detail = detail
+
+
+# Node kinds that carry a genuine batch axis: programs made only of
+# these can fuse across concurrent requests in the dispatch batcher
+# (params concatenate along B).  bsi_minmax has no batch axis and
+# group_counts' leading axis is the combo grid, so programs containing
+# them launch un-fused.
+_BATCH_KINDS = frozenset({"count", "segments", "row_counts", "bsi_sum"})
+
+
+def node_keys(node, mesh) -> list[tuple[str, str]]:
+    """Deterministic (field, view) key list one reducer node reads."""
+    if node.kind in ("count", "segments"):
+        return plan_inputs(node.plan)
+    if node.kind == "group_counts":
+        keys = [node.primary]
+        for k in node.extra[:-1]:
+            if k not in keys:
+                keys.append(k)
+        for k in (plan_inputs(node.plan) if node.plan is not None else []):
+            if k not in keys:
+                keys.append(k)
+        return keys
+    return mesh.batch_keys(node.primary, node.plan)
+
+
+def program_keys(program, mesh) -> list[tuple[str, str]]:
+    """Union of every node's keys, order-deterministic — the single
+    stacked key list the whole request stages (and the shard schedule
+    prefetches) once."""
+    out: list[tuple[str, str]] = []
+    for node in program:
+        for k in node_keys(node, mesh):
+            if k not in out:
+                out.append(k)
+    return out
+
+
+def pad_pow2_rows(mat: np.ndarray, repeat: bool = True) -> np.ndarray:
+    """Pad a params matrix's row count up to a power of two so arbitrary
+    batch sizes reuse a bounded set of compiled programs (the batcher's
+    convention).  ``repeat`` duplicates the last row (always in-range);
+    otherwise zero rows (GroupBy combo grids, matching the legacy
+    chunk padding)."""
+    B = mat.shape[0]
+    pad = 1 << max(0, B - 1).bit_length()
+    if pad == B:
+        return mat
+    if repeat:
+        return np.concatenate([mat, np.repeat(mat[-1:], pad - B, axis=0)])
+    return np.concatenate(
+        [mat, np.zeros((pad - B,) + mat.shape[1:], mat.dtype)])
+
+
+def _mat_rows(mat) -> int:
+    return mat[0].shape[0] if isinstance(mat, tuple) else mat.shape[0]
+
+
+class WholeOut:
+    """One whole-query launch's unfetched device outputs.
+
+    ``parts[i]`` is node i's device arrays (unfetched, so the executor
+    keeps its dispatch-all-then-fetch-once pipeline); ``meta[i]``
+    carries the host-assembly facts the finalizers need (per-group
+    shard lists, fragment-less shards, actual batch rows)."""
+
+    __slots__ = ("parts", "meta")
+
+    def __init__(self, parts, meta):
+        self.parts = parts
+        self.meta = meta
+
+    def slice_batch(self, program, node_lo: list[int], node_b: list[int]):
+        """A fused launch's per-ticket view: slice every node's batch
+        axis back out (batch-kind nodes only — fusibility is checked
+        before tickets coalesce)."""
+        parts, meta = [], []
+        for ni, node in enumerate(program):
+            lo, b = node_lo[ni], node_b[ni]
+            m = dict(self.meta[ni])
+            m["B"] = b
+            if node.kind == "segments":
+                parts.append([arr[:, lo:lo + b] for arr in self.parts[ni]])
+            else:
+                parts.append([arr[lo:lo + b] for arr in self.parts[ni]])
+            meta.append(m)
+        return WholeOut(parts, meta)
+
+
+class _InstrumentedWhole:
+    """One compiled whole-query program plus its device-runtime
+    telemetry — the wholequery analog of mesh_exec._InstrumentedExec:
+    the traced body marks the compile registry (exact retrace
+    detection), and every invocation lands in the launch ledger with
+    the call site's actual-vs-padded shard and batch rows."""
+
+    __slots__ = ("fn", "sig", "detail", "out_index")
+
+    def __init__(self, fn, key, out_index):
+        self.fn = fn
+        self.sig = _devobs.sig_of(key)
+        self.detail = repr(key[1])[:120]
+        self.out_index = out_index
+
+    def __call__(self, mats, *flat, _launch_meta=None):
+        reg = _devobs.COMPILES
+        reg.begin_call()
+        t0 = _time.perf_counter()
+        out = self.fn(mats, *flat)
+        dt = _time.perf_counter() - t0
+        compiled = reg.traced()
+        if compiled:  # fingerprinting is only paid on compiles
+            leaves = jax.tree_util.tree_leaves(mats)
+            reg.note_call(self.sig, "wholequery", dt,
+                          _devobs.fingerprint(list(leaves) + list(flat)),
+                          detail=self.detail)
+        m = _launch_meta or {}
+        ctx = _devobs.launch_ctx() or {}
+        rows = ctx.get("rows")
+        if rows is None:
+            rows = m.get("rows", 1)
+        _devobs.LEDGER.record(
+            sig=self.sig, kind="wholequery",
+            shards=m.get("shards", 0),
+            shards_padded=m.get("shards_padded", 0),
+            batch_rows=rows, batch_rows_padded=m.get("rows_padded", 1),
+            queue_s=ctx.get("queue_s", 0.0),
+            tickets=ctx.get("tickets", 1),
+            dispatch_s=dt, compiled=compiled,
+            decode_bytes=m.get("decode_bytes", 0),
+            slice_pos=_devobs.current_slice())
+        prof = qprof.current()
+        if prof is not None:
+            prof.event("device.launch", dt, kind="wholequery",
+                       sig=self.sig, shards=m.get("shards", 0),
+                       compiled=compiled)
+        return out
+
+
+def _node_shard(node, mat, frags):
+    """One reducer node's per-shard contribution, traced inside the
+    vmapped per-shard pass (decode has already produced dense tiles in
+    ``frags``).  Shapes mirror the legacy per-stage executables exactly
+    — including int32 accumulation — so results stay byte-identical."""
+    if node.kind in ("count", "segments"):
+        segs = jax.vmap(lambda p: eval_plan(node.plan, frags, p))(mat)
+        if node.kind == "segments":
+            return segs                                    # [B, W]
+        return jnp.sum(
+            jax.lax.population_count(segs).astype(jnp.int32),
+            axis=-1)                                       # [B]
+    frag = frags[node.primary]
+    if node.kind == "row_counts":
+        if node.plan is None:
+            counts = jnp.sum(
+                jax.lax.population_count(frag).astype(jnp.int32), axis=-1)
+            return jnp.broadcast_to(counts,
+                                    (mat.shape[0],) + counts.shape)
+        masks = jax.vmap(lambda p: eval_plan(node.plan, frags, p))(mat)
+        masked = frag[None, :, :] & masks[:, None, :]
+        return jnp.sum(
+            jax.lax.population_count(masked).astype(jnp.int32),
+            axis=-1)                                       # [B, rows]
+    if node.kind == "bsi_sum":
+        if node.plan is None:
+            counts = bsi.sum_counts(frag, None)
+            return jnp.broadcast_to(counts,
+                                    (mat.shape[0],) + counts.shape)
+        return jax.vmap(
+            lambda p: bsi.sum_counts(frag, eval_plan(node.plan, frags,
+                                                     p)))(
+            mat)                                           # [B, 2, d+1]
+    if node.kind == "bsi_minmax":
+        filt = None
+        if node.plan is not None:
+            filt = eval_plan(node.plan, frags, mat[0])
+        return bsi.min_max_bits(frag, filt,
+                                want_max=node.extra[0] == "max")
+    # group_counts: combos ride the leading axis of mat[0]
+    rids, params = mat
+    pk_list = node.extra[:-1]
+    fseg = eval_plan(node.plan, frags, params) \
+        if node.plan is not None else None
+
+    def one_combo(rids_row):
+        mask = None
+        for j, pk in enumerate(pk_list):
+            pfrag = frags[pk]
+            rid = rids_row[j]
+            if pfrag.shape[0] == 0:
+                seg = jnp.zeros(pfrag.shape[-1], dtype=pfrag.dtype)
+            else:
+                seg = jnp.where(
+                    rid < pfrag.shape[0],
+                    jax.lax.dynamic_index_in_dim(
+                        pfrag, jnp.minimum(rid, pfrag.shape[0] - 1),
+                        axis=0, keepdims=False),
+                    jnp.zeros_like(pfrag[0]))
+            mask = seg if mask is None else mask & seg
+        if fseg is not None:
+            mask = fseg if mask is None else mask & fseg
+        masked = frag if mask is None else frag & mask[None, :]
+        return jnp.sum(
+            jax.lax.population_count(masked).astype(jnp.int32),
+            axis=-1)                                       # [rows]
+
+    return jax.vmap(one_combo)(rids)                       # [C, rows]
+
+
+class WholeQueryRunner:
+    """Compiles + launches whole-query programs over a MeshExecutor's
+    mesh, reusing its stacked-input staging (stack cache, device
+    budget, compressed residency, ingest overlays) and executable
+    cache verbatim."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    # -- shape probes ------------------------------------------------------
+
+    def program_keys(self, program):
+        return program_keys(program, self.mesh)
+
+    def fusible(self, program) -> bool:
+        return all(n.kind in _BATCH_KINDS for n in program)
+
+    def precheck(self, program, holder, index, shards):
+        """Raise WholeQueryUnsupported for shapes the single-program
+        path cannot take; returns the program's stacked key list."""
+        mesh = self.mesh
+        if mesh.multiprocess:
+            raise WholeQueryUnsupported(
+                "multiprocess-mesh",
+                "per-process staging must stay deterministic")
+        keys = self.program_keys(program)
+        if keys and shards:
+            sched = mesh.shard_schedule(holder, index, [keys], shards)
+            if len(sched.slices) > 1:
+                raise WholeQueryUnsupported(
+                    "streamed-working-set",
+                    f"{len(sched.slices)} shard slices")
+        return keys
+
+    @staticmethod
+    def _participates(node, sig_map) -> bool:
+        """Whether a shape group contributes to a node (mirrors the
+        legacy per-stage skip conditions exactly)."""
+        if node.kind in ("count", "segments"):
+            return True
+        s0 = sig_map.get(node.primary)
+        if s0 is None:
+            return False
+        if node.kind in ("bsi_sum", "bsi_minmax") and \
+                _sig_rows(s0) < bsi.OFFSET_ROW + 1:
+            return False
+        if node.kind == "group_counts":
+            return all(sig_map.get(pk) is not None
+                       for pk in node.extra[:-1])
+        return True
+
+    # -- execution ---------------------------------------------------------
+
+    def run(self, program, mats, holder, index, shards) -> WholeOut:
+        """Stage the request's inputs and launch the whole program as
+        one device computation.  ``mats`` is one int32 params matrix
+        per node ([B, P]; group_counts nodes carry (rids[C, Pk],
+        params[Pf])).  Returns unfetched device parts per node."""
+        mesh = self.mesh
+        keys = self.precheck(program, holder, index, shards)
+        FAULTS.hit("mesh.slice", key=index)
+        check_current("whole-query dispatch")
+        groups = mesh._placed_groups(keys, holder, index, list(shards)) \
+            if keys and shards else []
+
+        live = []           # (shard_list, sig_map, flat, layout, pk, ps)
+        empty_shards: list[int] = []
+        for shard_list, placed, sig in groups:
+            if all(s is None for s in sig):
+                empty_shards.extend(shard_list)
+                continue
+            present = mesh._present(keys, placed, sig)
+            flat_g, layout_g = _flatten_present(present)
+            live.append((shard_list, dict(zip(keys, sig)), flat_g,
+                         layout_g, tuple(k for k, _, _ in present),
+                         tuple(s for _, _, s in present)))
+
+        pad_mats = []
+        actual_b = []
+        for node, mat in zip(program, mats):
+            if node.kind == "group_counts":
+                rids, params = mat
+                actual_b.append(rids.shape[0])
+                pad_mats.append((pad_pow2_rows(
+                    np.asarray(rids, dtype=np.int32), repeat=False),
+                    np.asarray(params, dtype=np.int32)))
+            else:
+                m = np.ascontiguousarray(mat, dtype=np.int32)
+                actual_b.append(m.shape[0])
+                pad_mats.append(pad_pow2_rows(m))
+        pad_mats = tuple(pad_mats)
+
+        # per-node schedule: which live groups contribute (static)
+        sched = tuple(
+            tuple(gi for gi, g in enumerate(live)
+                  if self._participates(node, g[1]))
+            for node in program)
+        meta = self._node_meta(program, actual_b, live, sched,
+                               empty_shards)
+        if not live:
+            return WholeOut([[] for _ in program], meta)
+
+        # The shard-bucket (stacked leading dim) is deliberately NOT in
+        # the key: like every mesh executable, a bucket change re-traces
+        # the cached program — the compile registry's retrace red flag
+        # (PR 8 convention; everything the body reads is frozen static
+        # structure, so the re-trace is correct by construction).
+        buckets = tuple(g[2][0].shape[0] for g in live)
+        key = ("wholequery", repr(program),
+               tuple((g[4], g[5]) for g in live),
+               tuple(jax.tree_util.tree_map(lambda a: a.shape,
+                                            pad_mats)),
+               mesh._exec_seq)
+        with mesh._lock:
+            fn = mesh._cache.get(key)
+            if fn is None:
+                fn = self._compile(key, program, live, sched, pad_mats)
+                mesh._cache[key] = fn
+
+        flat_all = [a for g in live for a in g[2]]
+        decode_bytes = sum(
+            bucket * sum(s[1] * SHARD_WORDS * 4
+                         for _, n, s in g[3] if n > 1)
+            for bucket, g in zip(buckets, live))
+        launch_meta = {
+            "shards": sum(len(g[0]) for g in live),
+            "shards_padded": sum(buckets),
+            "rows": sum(actual_b),
+            "rows_padded": sum(_mat_rows(m) for m in pad_mats),
+            "decode_bytes": decode_bytes,
+        }
+        sharding = NamedSharding(mesh.mesh, P())
+        mats_dev = jax.device_put(pad_mats, sharding)
+        with _DISPATCH_LOCK:
+            flat_out = fn(mats_dev, *flat_all, _launch_meta=launch_meta)
+        parts = [[flat_out[j] for j in idxs] for idxs in fn.out_index]
+        return WholeOut(parts, meta)
+
+    def _node_meta(self, program, actual_b, live, sched, empty_shards):
+        meta = []
+        for ni, node in enumerate(program):
+            m = {"B": actual_b[ni]}
+            if node.kind == "segments":
+                m["groups"] = [live[gi][0] for gi in sched[ni]]
+                m["empty"] = list(empty_shards)
+            elif node.kind == "bsi_minmax":
+                m["groups"] = [live[gi][0] for gi in sched[ni]]
+            meta.append(m)
+        return meta
+
+    # -- compilation -------------------------------------------------------
+
+    def _compile(self, key, program, live, sched, pad_mats):
+        """Build + jit the program body.  Everything consulted inside
+        the traced body is frozen static structure (program nodes,
+        layouts, participation schedule, combine shapes) — the body
+        takes only (mats, *stacked arrays)."""
+        groups_static = tuple((g[3], len(g[2])) for g in live)
+        sig_maps = tuple(g[1] for g in live)
+
+        # per-node static combine targets (max rows / max BSI depth);
+        # single-assignment so the traced body's closure cell can never
+        # change under a re-trace (the PR 7 bug class)
+        def _combine_info(ni, node):
+            if node.kind in ("row_counts", "group_counts"):
+                return {"rows": max(
+                    (_sig_rows(sig_maps[gi][node.primary])
+                     for gi in sched[ni]), default=0)}
+            if node.kind == "bsi_sum":
+                return {"depth": max(
+                    (_sig_rows(sig_maps[gi][node.primary])
+                     - bsi.OFFSET_ROW for gi in sched[ni]), default=0)}
+            return {}
+
+        combine = tuple(_combine_info(ni, node)
+                        for ni, node in enumerate(program))
+
+        def body(mats, *flat):
+            # Inside shard_map: ``flat`` are the per-device LOCAL blocks
+            # of the stacked arrays ([S_local, ...]); mats are
+            # replicated.  Reductions sum locally and psum over the
+            # named shard axis — the in-program collective that replaces
+            # the legacy host-assembled per-shard reductions.
+            per_group_raw: list[dict] = [dict() for _ in groups_static]
+            i = 0
+            for gi, (layout_g, n_g) in enumerate(groups_static):
+                arrs = flat[i:i + n_g]
+                i += n_g
+                node_ids = tuple(
+                    ni for ni in range(len(program)) if gi in sched[ni])
+                if not node_ids:
+                    continue
+
+                def per_shard(*arrays, _layout=layout_g,
+                              _nis=node_ids):
+                    frags = _unpack_frags(_layout, arrays)
+                    return tuple(
+                        _node_shard(program[ni], mats[ni], frags)
+                        for ni in _nis)
+
+                outs_g = jax.vmap(per_shard)(*arrs)
+                for slot, ni in enumerate(node_ids):
+                    per_group_raw[gi][ni] = outs_g[slot]
+
+            flat_outs: list = []
+            for ni, node in enumerate(program):
+                parts = [per_group_raw[gi][ni] for gi in sched[ni]]
+                if node.kind == "segments":
+                    flat_outs.extend(parts)   # [S_local, B, W] per group
+                elif node.kind == "bsi_minmax":
+                    for p in parts:                 # (bits, neg, cnt)
+                        flat_outs.extend(p)
+                elif not parts:
+                    pass                            # no contributing group
+                elif node.kind == "count":
+                    total = parts[0].sum(axis=0)
+                    for p in parts[1:]:
+                        total = total + p.sum(axis=0)
+                    flat_outs.append(
+                        jax.lax.psum(total, axis_name=SHARD_AXIS))  # [B]
+                elif node.kind == "bsi_sum":
+                    D = combine[ni]["depth"]
+                    B = mats[ni].shape[0]
+                    acc = jnp.zeros((B, 2, D + 1), dtype=jnp.int32)
+                    for p in parts:
+                        s = p.sum(axis=0)           # [B, 2, d+1]
+                        d = s.shape[-1] - 1
+                        # magnitude counts and the trailing TOTAL column
+                        # land separately: groups of different bit depth
+                        # must not add a total into a magnitude slot
+                        acc = acc.at[:, :, :d].add(s[:, :, :d])
+                        acc = acc.at[:, :, D].add(s[:, :, d])
+                    flat_outs.append(
+                        jax.lax.psum(acc, axis_name=SHARD_AXIS))
+                else:  # row_counts / group_counts
+                    R = combine[ni]["rows"]
+                    B = _mat_rows(mats[ni])
+                    acc = jnp.zeros((B, R), dtype=jnp.int32)
+                    for p in parts:
+                        s = p.sum(axis=0)           # [B, rows_g]
+                        acc = acc.at[:, :s.shape[1]].add(s)
+                    flat_outs.append(
+                        jax.lax.psum(acc, axis_name=SHARD_AXIS))
+            return tuple(flat_outs)
+
+        # flat-output index map + per-output PartitionSpec, computed
+        # statically from the schedule (mirrors body's append order):
+        # reduced outputs are replicated (psum), per-shard outputs keep
+        # the shard axis
+        out_index: list[list[int]] = []
+        out_specs: list = []
+        n_out = 0
+        for ni, node in enumerate(program):
+            if node.kind in ("segments", "bsi_minmax"):
+                n_here = len(sched[ni]) * (3 if node.kind == "bsi_minmax"
+                                           else 1)
+                out_specs.extend([P(SHARD_AXIS)] * n_here)
+            else:
+                n_here = 1 if sched[ni] else 0
+                out_specs.extend([P()] * n_here)
+            out_index.append(list(range(n_out, n_out + n_here)))
+            n_out += n_here
+
+        def traced(mats, *flat):
+            # runs ONLY while jax traces: an exact compile detector
+            _devobs.COMPILES.mark_traced()
+            return body(mats, *flat)
+
+        n_flat_all = sum(n for _, n in groups_static)
+        fn = jax.jit(_shard_map(
+            traced, mesh=self.mesh.mesh,
+            in_specs=(P(),) + (P(SHARD_AXIS),) * n_flat_all,
+            out_specs=tuple(out_specs),
+            **{_SM_CHECK_KW: True}))
+        return _InstrumentedWhole(fn, key, out_index)

@@ -478,6 +478,16 @@ def build_router(api: API, server=None) -> Router:
         # p50/p99 — the knobs' feedback loop for tuning window/max
         if ex.batcher is not None:
             out["dispatchBatcher"] = ex.batcher.snapshot()
+        # whole-query pjit programs (docs/whole-query.md): requests
+        # served as one program vs fallbacks to the legacy per-stage
+        # path, with the last fallback's unsupported-node name
+        if ex.wholequery is not None:
+            out["wholeQuery"] = {
+                "enabled": ex.whole_query,
+                "requests": ex.wq_requests,
+                "fallbacks": ex.wq_fallbacks,
+                "lastFallback": ex.wq_last_fallback,
+            }
         # overload armor: slot/queue state, per-peer breaker state, armed
         # failpoints (docs/robustness.md); deadline-abort and admission
         # rejection COUNTERS live in "counts" via the stats client

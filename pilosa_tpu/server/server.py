@@ -44,6 +44,17 @@ class Config:
     # Microseconds the oldest queued ticket may wait for company before
     # the batch launches anyway (the solo-query latency tax ceiling).
     dispatch_batch_window_us: float = 200.0
+    # -- whole-query pjit programs (docs/whole-query.md) -------------------
+    # Compile each read request into ONE pjit program over the mesh
+    # (every call, every shape group, reductions in-program) instead of
+    # one executable per reducer stage.  Off restores the legacy
+    # per-stage dispatch exactly (the kill switch).
+    whole_query: bool = True
+    # Fallback policy for shapes the program can't express: "legacy"
+    # reroutes to the per-stage path (counted `wholequery.fallback` +
+    # structured log event); "error" raises instead — a debugging mode
+    # that makes every silent slow path loud.
+    whole_query_fallback: str = "legacy"
     # HBM budget for device-resident fragment mirrors + stacked shard
     # blocks (storage/membudget.py DeviceBudget — the syswrap map-cap
     # analog, syswrap/mmap.go:46).  0 = unlimited (accounting only).
@@ -209,6 +220,10 @@ class Config:
             "PILOSA_TPU_DISPATCH_BATCH_MAX": ("dispatch_batch_max", int),
             "PILOSA_TPU_DISPATCH_BATCH_WINDOW_US": (
                 "dispatch_batch_window_us", float),
+            "PILOSA_TPU_WHOLE_QUERY": (
+                "whole_query", lambda s: s != "false"),
+            "PILOSA_TPU_WHOLE_QUERY_FALLBACK": ("whole_query_fallback",
+                                                str),
             "PILOSA_TPU_DEVICE_BUDGET_MB": ("device_budget_mb", int),
             "PILOSA_TPU_HOST_STAGE_MB": ("host_stage_mb", int),
             "PILOSA_TPU_COMPRESSED_RESIDENT": (
@@ -284,6 +299,8 @@ class Config:
             "dispatch-batch": "dispatch_batch",
             "dispatch-batch-max": "dispatch_batch_max",
             "dispatch-batch-window-us": "dispatch_batch_window_us",
+            "whole-query": "whole_query",
+            "whole-query-fallback": "whole_query_fallback",
             "device-budget-mb": "device_budget_mb",
             "host-stage-mb": "host_stage_mb",
             "compressed-resident": "compressed_resident",
@@ -409,7 +426,12 @@ class Server:
             use_mesh=self.config.use_mesh,
             dispatch_batch=self.config.dispatch_batch,
             dispatch_batch_max=self.config.dispatch_batch_max,
-            dispatch_batch_window_us=self.config.dispatch_batch_window_us)
+            dispatch_batch_window_us=self.config.dispatch_batch_window_us,
+            whole_query=self.config.whole_query,
+            whole_query_fallback=self.config.whole_query_fallback)
+        # wholequery.fallback events land in the server log (the
+        # executor stays silent standalone, like the compile registry)
+        self.api.executor.logger = self.logger
         # query cache subsystem (docs/caching.md): byte budget for the
         # result cache; the rank-rebuild threshold is process-wide like
         # the memory budgets (most recent Server's config wins)

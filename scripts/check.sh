@@ -29,10 +29,14 @@ python -m pilosa_tpu.analysis
 # class, so its test is hygiene as well.  The streaming-ingest suite
 # (docs/ingest.md) joins them: wire-codec corruption fuzz, the
 # ingest-vs-bulk differential, group-commit counting, and the kill -9
-# commit-window harness are all acked-durability guarantees.
+# commit-window harness are all acked-durability guarantees.  The
+# whole-query differential (docs/whole-query.md) rides for the same
+# reason: the single-program path serves every read request by
+# default, and a lowering bug corrupts answers silently — the
+# three-leg byte-identity suite is hygiene, not a nicety.
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' -p no:cacheprovider \
     tests/test_durability.py tests/test_crash.py tests/test_containers.py \
-    tests/test_device_obs.py tests/test_ingest.py
+    tests/test_device_obs.py tests/test_ingest.py tests/test_wholequery.py
 
 # committed bytecode/cache artifacts must never land in the tree (shell
 # stays the right layer for a git-index check)

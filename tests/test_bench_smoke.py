@@ -26,6 +26,15 @@ def test_bench_smoke_resident_and_budgeted():
     assert data["evictions"] > 0
     assert data["prefetch_hits"] + data["prefetch_misses"] > 0
     assert data["pinned_bytes"] == 0  # all pins released
+    # whole-query leg (docs/whole-query.md): answers identical with the
+    # program path on vs off, and a Count(Intersect)-class request was
+    # exactly ONE launch on the ledger (kind wholequery) — the
+    # single-launch-per-request acceptance
+    wq = data["wholequery"]
+    assert wq["answers_identical"] is True
+    assert wq["single_launch"] is True
+    assert wq["qps_on"] > 0 and wq["qps_off"] > 0
+    assert wq["wq_requests"] > 0
     # compressed-residency leg (docs/memory-budget.md): the budget held
     # under a limit below the dense working set, the staged footprint is
     # genuinely compressed, and results were identical to the dense run
