@@ -574,10 +574,16 @@ N_SHARDS5D = 256  # ~268M columns over 4 nodes
 def bench_config5_distributed(rng):
     """BASELINE config 5's cluster half: 4 real server nodes in-process
     (sharing the one local accelerator), dense SSB-shaped data loaded
-    through the binary roaring import surface, Intersect+TopN fanned out
-    as pinned multi-call batches and reduced over real HTTP
-    (executor.go:2414-2552 scatter/gather).  Publishes vs_cpu against
-    the same word-wise oracle as config 5 plus the coordinator's
+    through the binary roaring import surface, queries fanned out as
+    pinned multi-call batches and reduced over real HTTP
+    (executor.go:2414-2552 scatter/gather).  The measured load is a
+    RECORDED mixed-workload replay: a varied workload (TopN batches,
+    Count(Intersect), Row fetches) runs once with the slow-query
+    threshold dropped to ~0 so the PR 5 slow-log ring records every
+    query, and the recorded texts are then replayed as the measured
+    corpus — traffic shaped like what the cluster actually served, not
+    synthetic-uniform batches.  Publishes vs_cpu against the same
+    word-wise oracle as config 5 plus the coordinator's
     device/wire/reduce latency breakdown from /debug/vars."""
     import http.client
     import socket
@@ -617,7 +623,8 @@ def bench_config5_distributed(rng):
             srv = Server(Config(
                 data_dir=tempfile.mkdtemp(prefix=f"ptpu_b5d_{i}_"),
                 bind=hosts[i], node_id=f"node{i}", cluster_hosts=hosts,
-                replica_n=1, anti_entropy_interval=0))
+                replica_n=1, anti_entropy_interval=0,
+                slow_log_size=2048))
             servers.append(srv)  # before open: finally closes partials
             srv.open()
         p0 = ports[0]
@@ -674,6 +681,40 @@ def bench_config5_distributed(rng):
         got_pairs = [(p["id"], p["count"]) for p in got["results"][0]]
         assert got_pairs == want, f"5d mismatch: {got_pairs} != {want}"
 
+        # -- record phase (docs/cluster.md; the PR 5 slow-log corpus):
+        # drop every node's slow threshold to ~0 so the ring records the
+        # whole mixed workload — TopN batches plus Count(Intersect) and
+        # Row singles — then harvest the recorded query texts as the
+        # replay corpus and restore the threshold before measuring
+        for srv in servers:
+            srv.slowlog.threshold_s = 1e-9
+        # recorded batches stay under the slow log's QUERY_TEXT_MAX so
+        # the harvested text replays verbatim (longer entries are stored
+        # truncated — the filter below drops any that were)
+        mixed = [_cfg5_batch(rng, 4) for _ in range(12)]
+        for i in range(16):
+            a = int(rng.integers(0, 4))
+            b = (a + 1 + int(rng.integers(0, 3))) % 4
+            mixed.append(
+                f"Count(Intersect(Row(seg={a}), Row(seg={b})))"
+                if i % 2 else f"Row(seg={a})")
+        for i, m in enumerate(mixed):
+            post(ports[i % 4], "/index/dist/query", m.encode(),
+                 timeout=1800)
+        from pilosa_tpu.utils.slowlog import QUERY_TEXT_MAX
+        corpus = []
+        for p in ports:
+            slow = json.loads(req(p, "GET", "/debug/slow"))
+            corpus.extend(
+                e["query"] for e in slow.get("entries", [])
+                if e.get("index") == "dist" and e.get("query")
+                and len(e["query"]) < QUERY_TEXT_MAX)
+        assert len(corpus) >= len(mixed), \
+            f"slow-log recorded only {len(corpus)} of {len(mixed)}"
+        for srv in servers:
+            srv.slowlog.threshold_s = 1.0
+        calls_per_replay = sum(max(q.count("TopN("), 1) for q in corpus)
+
         # baseline the timing counters AFTER warm-up: the warm waves pay
         # each node's XLA compile (seconds), which must not pollute the
         # per-wave averages published below
@@ -681,8 +722,8 @@ def bench_config5_distributed(rng):
         t0s = snap0.get("timings", {})
 
         def run():
-            batches = [(ports[i % 4], batch().encode())
-                       for i in range(n_batches)]
+            batches = [(ports[i % 4], corpus[i % len(corpus)].encode())
+                       for i in range(len(corpus))]
             lats = []
 
             def post_one(pb):
@@ -693,7 +734,7 @@ def bench_config5_distributed(rng):
             t0 = time.perf_counter()
             with ThreadPoolExecutor(T) as pool:
                 list(pool.map(post_one, batches))
-            return (B * n_batches / (time.perf_counter() - t0),
+            return (calls_per_replay / (time.perf_counter() - t0),
                     float(np.median(lats)))
 
         (qps, p50_s), spread = best_of(run)
@@ -719,6 +760,8 @@ def bench_config5_distributed(rng):
             "batch_p50_ms": round(p50_s * 1e3, 1),
             "spread": spread,
             "nodes": 4,
+            "workload": "recorded_replay",
+            "corpus_queries": len(corpus),
             "columns": N_SHARDS5D * SHARD_WIDTH,
             "vs_cpu": round(qps / oracle_qps, 2),
             "cpu_qps": round(oracle_qps, 2),
@@ -737,6 +780,158 @@ def bench_config5_distributed(rng):
             # server may already be down and the leg's numbers are in
             except Exception:
                 pass
+
+
+def _routing_leg(rng, *, n_cold_shards=6, waves=4, wave_q=64, threads=8,
+                 hot_bits=6000, cold_bits=4000):
+    """Elastic-serving leg (docs/cluster.md "Read routing &
+    rebalancing"): 3 real server nodes in-process, replica_n=2, and a
+    SKEWED workload — ~80% of queries hit a hot 2-shard index, the rest
+    spread over a cold index — replayed under read-routing=primary
+    (reads pinned to the jump-hash primary, the pre-PR-13 behavior) and
+    then read-routing=loaded.  Asserts the two runs answer byte-
+    identically and reports qps for both plus the per-shard replica
+    spread (how many nodes served each hot shard under loaded — the
+    idle-replica signal this subsystem exists to fix)."""
+    import http.client
+    import socket
+    import tempfile
+    import threading
+
+    from pilosa_tpu.core import SHARD_WIDTH
+    from pilosa_tpu.server import Config, Server
+
+    socks = []
+    for _ in range(3):
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = []
+
+    def post(port, path, body: bytes, timeout=600):
+        conn = http.client.HTTPConnection("localhost", port,
+                                          timeout=timeout)
+        conn.request("POST", path, body=body)
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        if resp.status != 200:
+            raise RuntimeError(f"{path}: {resp.status} {data[:200]!r}")
+        return json.loads(data)
+
+    try:
+        for i, p in enumerate(ports):
+            srv = Server(Config(
+                data_dir=tempfile.mkdtemp(prefix=f"ptpu_rt_{i}_"),
+                bind=hosts[i], node_id=f"node{i}", cluster_hosts=hosts,
+                replica_n=2, anti_entropy_interval=0))
+            servers.append(srv)
+            srv.open()
+        p0 = ports[0]
+        for name, n_shards, n_bits in (("hotidx", 2, hot_bits),
+                                       ("coldidx", n_cold_shards,
+                                        cold_bits)):
+            post(p0, f"/index/{name}", b"{}")
+            post(p0, f"/index/{name}/field/a", b"{}")
+            cols = np.unique(rng.integers(0, n_shards * SHARD_WIDTH,
+                                          size=n_bits))
+            rows = rng.integers(0, 8, size=cols.size)
+            post(p0, f"/index/{name}/field/a/import", json.dumps({
+                "rowIDs": rows.tolist(),
+                "columnIDs": cols.tolist()}).encode())
+
+        def gen_q():
+            a = int(rng.integers(0, 8))
+            b = (a + 1 + int(rng.integers(0, 6))) % 8
+            hot = rng.random() < 0.8
+            idx = "hotidx" if hot else "coldidx"
+            kind = int(rng.integers(0, 4))
+            if kind == 0:
+                q = f"Count(Intersect(Row(a={a}), Row(a={b})))"
+            elif kind == 1:
+                q = f"Count(Row(a={a}))"
+            elif kind == 2:
+                q = f"Row(a={a})"
+            else:
+                q = "TopN(a, n=0)"  # exact cluster reduce
+            return idx, q
+
+        corpus = [gen_q() for _ in range(wave_q)]
+        # warm every node's compiles before timing
+        for p in ports:
+            for idx, q in corpus[:6]:
+                post(p, f"/index/{idx}/query", q.encode(), timeout=1800)
+        coord = servers[0].cluster
+
+        def run(policy):
+            for srv in servers:
+                srv.cluster.router.policy = policy
+            coord.load_tracker.rotate()
+            coord.load_tracker.rotate()
+            answers = {}
+            lock = threading.Lock()
+
+            def post_one(item):
+                i, (idx, q) = item
+                out = post(p0, f"/index/{idx}/query", q.encode())
+                with lock:
+                    answers[i % wave_q] = out["results"]
+
+            items = [(i, corpus[i % wave_q])
+                     for i in range(waves * wave_q)]
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(threads) as pool:
+                list(pool.map(post_one, items))
+            qps = len(items) / (time.perf_counter() - t0)
+            return qps, answers
+
+        qps_primary, ans_primary = run("primary")
+        qps_loaded, ans_loaded = run("loaded")
+        assert ans_loaded == ans_primary, \
+            "loaded routing diverged from primary-pinned answers"
+        # per-shard replica spread on the hot index under loaded
+        snap = coord.load_tracker.snapshot(top=32)
+        spread = {e["shard"]: len(e["nodes"]) for e in snap["hottest"]
+                  if e["index"] == "hotidx"}
+        return {
+            "answers_identical": True,
+            "qps_primary": round(qps_primary, 1),
+            "qps_loaded": round(qps_loaded, 1),
+            "loaded_vs_primary": round(qps_loaded / qps_primary, 3)
+            if qps_primary else None,
+            "hot_shard_nodes": max(spread.values(), default=0),
+            "hot_shard_spread": spread,
+            "fallbacks": servers[0].cluster.router.snapshot()["fallbacks"],
+        }
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            # lint: allow(swallowed-exception) — bench teardown; the
+            # server may already be down and the leg's numbers are in
+            except Exception:
+                pass
+
+
+def bench_routing(rng):
+    """Main-bench elastic-serving leg: the skewed-hot-index corpus at
+    full wave counts (see _routing_leg)."""
+    return _routing_leg(rng, waves=6, wave_q=64, threads=8)
+
+
+def run_routing_smoke(rng) -> dict:
+    """Routing leg of --smoke (docs/cluster.md): the skew corpus small —
+    routing-on (loaded) vs primary-pinned qps, answers asserted
+    identical, and the hot shards served by more than one node."""
+    out = _routing_leg(rng, waves=3, wave_q=24, threads=8,
+                       hot_bits=2500, cold_bits=1500, n_cold_shards=4)
+    assert out["hot_shard_nodes"] > 1, \
+        f"hot shards never spread: {out['hot_shard_spread']}"
+    return out
 
 
 # -- numpy oracle baselines (single-thread reference-algorithm stand-in) ----
@@ -1825,6 +2020,7 @@ def run_smoke():
         ex5.close()
     out["wholequery"] = run_wholequery_smoke(
         np.random.default_rng(SEED + 9))
+    out["routing"] = run_routing_smoke(np.random.default_rng(SEED + 10))
     out["compressed"] = run_compressed_smoke(np.random.default_rng(SEED + 6))
     out["ingest"] = run_ingest_smoke(np.random.default_rng(SEED + 8))
     out["cache"] = run_cache_smoke(np.random.default_rng(SEED + 3))
@@ -1903,6 +2099,16 @@ def main():
         print(f"config 5d failed: {e!r}", file=sys.stderr)
         traceback.print_exc()
         cfg5d = None
+
+    # elastic-serving config (docs/cluster.md): skewed-hot-index corpus,
+    # loaded routing vs primary-pinned on a replica_n=2 cluster
+    try:
+        routing_leg = bench_routing(np.random.default_rng(SEED + 10))
+    except Exception as e:
+        import traceback
+        print(f"routing config failed: {e!r}", file=sys.stderr)
+        traceback.print_exc()
+        routing_leg = None
 
     # concurrent-HTTP dynamic-batching config (docs/batching.md): the
     # served single-query path, dispatch-batch on vs off
@@ -2004,6 +2210,8 @@ def main():
         configs["8_streaming_ingest"] = ingest_leg
     if wq_leg:
         configs["9_whole_query"] = wq_leg
+    if routing_leg:
+        configs["10_elastic_routing"] = routing_leg
 
     print(json.dumps({
         "metric": "engine_intersect8_count_qps_1M_cols",

@@ -6,6 +6,7 @@ from . import (  # noqa: F401
     except_swallow,
     failpoints,
     metrics_docs,
+    router_bypass,
     thread_context,
     traced_closure,
     wallclock,

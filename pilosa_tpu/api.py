@@ -391,6 +391,14 @@ class API:
             out["dataGens"] = {
                 name: list(gen_summary(self.holder, name))
                 for name in list(self.holder.indexes)}
+            # elastic-serving piggybacks (parallel/routing.py): admission
+            # depth + per-shard residency tiers ride the health probes so
+            # peers' read routers score this node without extra RPCs, and
+            # the overlay epoch lets the coordinator re-push a missed
+            # placement-overlay broadcast (docs/cluster.md)
+            out["load"] = self.cluster.local_load()
+            out["residency"] = self.cluster.residency_summary()
+            out["overlayEpoch"] = self.cluster.overlay_epoch
         out.update({"state": state, "nodes": nodes, "epoch": epoch,
                     "localID": nodes[0]["id"] if self.cluster is None
                     else self.cluster.node_id})

@@ -404,6 +404,19 @@ def cmd_top(args) -> int:
                   f"launches {lau.get('launches', 0)}  "
                   f"padding {100 * lau.get('paddingWasteRatio', 0):.1f}%  "
                   f"decode peak {lau.get('decodePeakBytes', 0) // mb}MB")
+            # per-peer routing load (docs/cluster.md "Read routing &
+            # rebalancing"): EWMA RTT, in-flight depth, breaker state
+            routing = (v.get("cluster") or {}).get("routing") or {}
+            for nid, pr in sorted((routing.get("peers") or {}).items()):
+                rtt = pr.get("ewmaRttMs")
+                print(f"   peer {nid}: "
+                      f"rtt {rtt if rtt is not None else '-'}ms  "
+                      f"inflight {pr.get('inFlight', 0)}"
+                      f"+{pr.get('reportedInFlight', 0)}  "
+                      f"queued {pr.get('reportedQueued', 0)}  "
+                      f"dispatches {pr.get('dispatches', 0)}"
+                      f"{'  BREAKER-OPEN' if pr.get('breakerOpen') else ''}"
+                      f"{'  DOWN' if pr.get('state') == 'DOWN' else ''}")
             polls += 1
             if args.count and polls >= args.count:
                 return 0
@@ -463,6 +476,16 @@ max-op-n = 10000
 # timeseries-window = 600  # seconds of history the time-series ring keeps
 # launch-ledger-size = 256 # /debug/launches ring entries
 
+# elastic serving (docs/cluster.md "Read routing & rebalancing")
+# read-routing = "loaded"  # or "primary" (pin to jump-hash primary),
+#                          # "round-robin"
+# residency-routing = true # prefer the replica holding the shard
+#                          # HBM-resident / host-staged
+# balancer = false         # hot-shard handoffs (coordinator-driven,
+#                          # epoch-gated placement overlay)
+# balancer-interval = 30   # seconds between balancer ticks
+# hot-shard-threshold = 4  # hot = this multiple of the mean shard load
+
 [cluster]
 # hosts = ["localhost:10101", "localhost:10102"]
 replicas = 1
@@ -511,6 +534,11 @@ def cmd_config(args) -> int:
     print(f"breaker-threshold = {cfg.breaker_threshold}")
     print(f"drain-seconds = {cfg.drain_seconds}")
     print(f"health-down-threshold = {cfg.health_down_threshold}")
+    print(f"read-routing = {q(cfg.read_routing)}")
+    print(f"residency-routing = {str(cfg.residency_routing).lower()}")
+    print(f"balancer = {str(cfg.balancer).lower()}")
+    print(f"balancer-interval = {cfg.balancer_interval}")
+    print(f"hot-shard-threshold = {cfg.hot_shard_threshold}")
     print(f"wal-crc = {str(cfg.wal_crc).lower()}")
     print(f"quarantine-on-corruption = "
           f"{str(cfg.quarantine_on_corruption).lower()}")

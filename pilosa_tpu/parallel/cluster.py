@@ -306,6 +306,17 @@ class InternalClient:
                            "fastFails": b.fast_fails}
                     for host, b in self._breakers.items()}
 
+    def breaker_open(self, host: str) -> bool:
+        """Is ``host``'s circuit currently open?  The read router skips
+        such peers BEFORE dispatch (routing.breaker_skip) instead of
+        letting each fan-out burn a CircuitOpenError round through the
+        retry machinery.  Lock-free read: a racing transition costs one
+        query a suboptimal (but correct) replica choice."""
+        if self.breaker_threshold <= 0:
+            return False
+        b = self._breakers.get(host)
+        return b is not None and b.state == "open"
+
     def close(self):
         with self._conns_lock:
             conns, self._all_conns = self._all_conns, set()
@@ -536,10 +547,12 @@ class InternalClient:
         GLOBAL_TRACER.adopt(out.get("spans"))
         # 4th element: the peer's quarantined-fragment count for this
         # index — the coordinator folds it into the response's degraded
-        # flag (utils/degraded.py)
+        # flag (utils/degraded.py).  5th: the peer's admission-queue
+        # depth, piggybacked for the read router's load scores
+        # (parallel/routing.py — the same piggyback pattern as gens).
         return ([result_from_wire(r) for r in out["results"]],
                 float(out.get("execS", 0.0)), out.get("gens"),
-                int(out.get("quarantined", 0)))
+                int(out.get("quarantined", 0)), out.get("load"))
 
     def send_message(self, host: str, msg: dict,
                      timeout: float | None = None):
@@ -825,7 +838,12 @@ class Cluster:
     def __init__(self, node_id: str, hosts: list[str], replica_n: int = 1,
                  holder=None, hasher=None, health_interval: float = 5.0,
                  health_down_threshold: int = 2,
-                 breaker_threshold: int = 5, stats=None):
+                 breaker_threshold: int = 5, stats=None,
+                 read_routing: str = "loaded",
+                 residency_routing: bool = True,
+                 balancer: bool = False,
+                 balancer_interval: float = 30.0,
+                 hot_shard_threshold: float = 4.0):
         self.nodes = [Node(f"node{i}", h) for i, h in enumerate(hosts)]
         self.by_id = {n.id: n for n in self.nodes}
         if node_id not in self.by_id:
@@ -899,6 +917,34 @@ class Cluster:
         self._ae_last_error: str | None = None
         self._ae_last_error_ts: float | None = None
         self._ae_last_success_ts: float | None = None
+        # Elastic serving (docs/cluster.md "Read routing & rebalancing"):
+        # placement-overlay table — (index, shard) -> EXTRA owner ids the
+        # balancer appended beyond the jump-hash owners.  Epoch-gated and
+        # broadcast like resize-complete so all nodes route (and fan
+        # writes) consistently; persisted with the topology.  _overlay_lock
+        # is a leaf lock (never held across I/O or another lock).
+        self._overlay: dict[tuple[str, int], list[str]] = {}
+        self.overlay_epoch = 0
+        self._overlay_lock = make_lock("placement-overlay")
+        from .balancer import HotShardBalancer, ShardLoadTracker
+        from .routing import ReadRouter
+        self.router = ReadRouter(self, policy=read_routing,
+                                 residency_routing=residency_routing,
+                                 stats=stats)
+        self.load_tracker = ShardLoadTracker(
+            window_s=max(balancer_interval, 1.0))
+        self.balancer_on = bool(balancer)
+        self.balancer_interval = balancer_interval
+        self.balancer = HotShardBalancer(
+            self, self.load_tracker, threshold=hot_shard_threshold,
+            stats=stats)
+        # residency-summary TTL cache (walking every fragment per /status
+        # probe would make probes O(fragments); 2s staleness is far under
+        # RESIDENCY_TTL_S)
+        self._residency_cache: tuple[float, dict] | None = None
+        # set by Server.register_internal_routes: the admission pools the
+        # load piggyback reports (None standalone — zero-load answers)
+        self._server = None
         self._load_topology()
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * len(self.nodes)))
@@ -927,6 +973,17 @@ class Cluster:
             self._health_thread = threading.Thread(
                 target=self._monitor_health, daemon=True)
             self._health_thread.start()
+        if self.balancer_on and self.is_coordinator \
+                and self.balancer_interval > 0:
+            t = threading.Thread(target=self._monitor_balancer,
+                                 daemon=True)
+            t.start()
+
+    def _monitor_balancer(self):
+        """Hot-shard rebalancing cadence (coordinator only; the tick
+        itself never raises — failed handoffs count balancer.errors)."""
+        while not self._closing.wait(self.balancer_interval):
+            self.balancer.tick()
 
     def close(self):
         self._closing.set()
@@ -1022,6 +1079,10 @@ class Cluster:
             # cached entries within one health interval
             for iname, summary in (st.get("dataGens") or {}).items():
                 self.note_peer_gens(iname, n.id, tuple(summary))
+            # fold the peer's load + residency summary into the read
+            # router (parallel/routing.py): the probe cadence keeps tier
+            # preferences fresh even for peers the fan-out never hits
+            self.router.note_status(n.id, st)
             if was_down:
                 # every pooled connection to the peer predates its
                 # outage/restart — invalidate them BEFORE any traffic
@@ -1029,6 +1090,22 @@ class Cluster:
                 # keep-alive's response-phase failure turns recovery
                 # into spurious non-retryable POST errors
                 self.client.note_recovered(n.host)
+            peer_overlay = st.get("overlayEpoch")
+            if (self.is_coordinator and peer_overlay is not None
+                    and peer_overlay < self.overlay_epoch):
+                # straggler on an older placement overlay (missed the
+                # broadcast, or restarted with wiped state): re-push the
+                # full table, epoch-gated like resize-complete
+                try:
+                    self.client.send_message(n.host, {
+                        "type": "placement-overlay",
+                        "overlay": self._overlay_wire(),
+                        "epoch": self.overlay_epoch})
+                # lint: allow(swallowed-exception) — DOWN is the
+                # handling: probe reconciliation re-pushes next pass
+                except Exception:
+                    n.state = NODE_DOWN
+                    continue
             peer_epoch = st.get("epoch")
             if (self.is_coordinator and peer_epoch is not None
                     and peer_epoch < self.epoch):
@@ -1102,7 +1179,159 @@ class Cluster:
 
     def shard_nodes_info(self, index: str, shard: int) -> list[dict]:
         return [{"id": nid, "uri": self.by_id[nid].host}
-                for nid in self.placement.shard_nodes(index, shard)]
+                for nid in self.shard_owner_nodes(index, shard)]
+
+    # -- placement overlay (docs/cluster.md "Read routing & rebalancing") --
+
+    def shard_owner_nodes(self, index: str, shard: int) -> list[str]:
+        """Effective owners of a shard: the jump-hash placement owners
+        PLUS any overlay owners the balancer appended (hot-spot
+        splitting).  Every ownership decision — read routing, write
+        fan-out, import grouping, anti-entropy, the holder cleaner —
+        consults this, so an overlay owner is a full replica, not a
+        read-only cache.  With an empty overlay (balancer off, the
+        default) this is exactly ``placement.shard_nodes``."""
+        owners = self.placement.shard_nodes(index, shard)
+        with self._overlay_lock:
+            extras = self._overlay.get((index, shard))
+            if not extras:
+                return owners
+            return owners + [nid for nid in extras
+                             if nid in self.by_id and nid not in owners]
+
+    def owns_shard(self, node_id: str, index: str, shard: int) -> bool:
+        return node_id in self.shard_owner_nodes(index, shard)
+
+    def owned_shards(self, node_id: str, index: str, shards) -> list[int]:
+        """Overlay-aware ``placement.owned_shards``: shards (including
+        replicas and overlay extras) the node holds."""
+        return [s for s in shards
+                if node_id in self.shard_owner_nodes(index, s)]
+
+    def overlay_snapshot(self) -> dict:
+        with self._overlay_lock:
+            return {"epoch": self.overlay_epoch,
+                    "entries": [{"index": i, "shard": s, "extra": list(e)}
+                                for (i, s), e in
+                                sorted(self._overlay.items())]}
+
+    def _overlay_wire(self) -> list:
+        with self._overlay_lock:
+            return [[i, s, list(e)] for (i, s), e in
+                    sorted(self._overlay.items())]
+
+    def add_overlay(self, index: str, shard: int, node_id: str) -> bool:
+        """Coordinator: append an overlay owner for a shard, bump the
+        overlay epoch, persist, and broadcast the FULL table (like
+        resize-complete — receivers apply epoch-gated, stragglers get
+        probe re-pushes).  The caller (the balancer) has already copied
+        the shard's fragments to the node."""
+        if node_id not in self.by_id:
+            raise ClusterError(f"unknown overlay node {node_id!r}")
+        with self._overlay_lock:
+            if node_id in self.placement.shard_nodes(index, shard):
+                return False
+            extras = self._overlay.setdefault((index, shard), [])
+            if node_id in extras:
+                return False
+            extras.append(node_id)
+            self.overlay_epoch += 1
+        self._save_topology()
+        self.broadcast_overlay()
+        return True
+
+    def broadcast_overlay(self):
+        """Push the overlay table to every READY peer; failures mark the
+        peer DOWN and probe reconciliation re-pushes (the peer's /status
+        carries its overlayEpoch)."""
+        msg = {"type": "placement-overlay",
+               "overlay": self._overlay_wire(),
+               "epoch": self.overlay_epoch}
+        for n in self.peers():
+            if n.state != NODE_READY:
+                continue
+            try:
+                self.client.send_message(n.host, msg)
+            except Exception:
+                # DOWN is the handling: the probe's overlay-epoch
+                # reconciliation re-pushes the table next pass
+                self._mark_down(n.id)
+
+    def _apply_overlay(self, msg: dict):
+        """Receive a placement-overlay broadcast: epoch-gated full-table
+        replace (an older or duplicate push is an idempotent no-op ack,
+        exactly like resize-complete), persisted so a restart keeps
+        routing consistently."""
+        epoch = int(msg.get("epoch", 0))
+        with self._overlay_lock:
+            if epoch <= self.overlay_epoch:
+                return
+            self._overlay = {
+                (i, int(s)): [nid for nid in extras if nid in self.by_id]
+                for i, s, extras in msg.get("overlay", [])}
+            self.overlay_epoch = epoch
+        self._save_topology()
+
+    # -- residency tiers + load (status/query piggybacks) ------------------
+
+    # shards listed per tier per index in a residency summary; beyond it
+    # the summary truncates (the router treats unlisted as disk-only,
+    # which only costs a preference, never correctness)
+    RESIDENCY_MAX_SHARDS = 2048
+    RESIDENCY_CACHE_TTL = 2.0
+
+    def residency_summary(self) -> dict:
+        """Per-index shard residency tiers this node can serve from:
+        ``hbm`` (device mirror or a mesh stack holds the shard — answers
+        without an upload), ``host`` (dense stage / packed stream cached
+        — answers without re-expansion), everything else disk-only.
+        Advertised on /status probes; the router prefers replicas that
+        hold the queried shards high (docs/cluster.md).  TTL-cached:
+        probes and fan-outs must not walk every fragment each time.
+        Reads fragment attributes without their locks — a torn read
+        costs one probe interval of preference, never correctness."""
+        now = time.monotonic()
+        cached = self._residency_cache
+        if cached is not None and now - cached[0] < self.RESIDENCY_CACHE_TTL:
+            return cached[1]
+        hbm: dict[str, set[int]] = {}
+        host: dict[str, set[int]] = {}
+        api = self.api
+        mesh = getattr(getattr(api, "executor", None), "mesh_exec", None) \
+            if api is not None else None
+        if mesh is not None:
+            with mesh._sc_lock:
+                stack_keys = list(mesh._stack_cache.keys())
+            for iname, _keys, shards in stack_keys:
+                hbm.setdefault(iname, set()).update(int(s) for s in shards)
+        if self.holder is not None:
+            for iname, _f, _v, shard, frag in self.holder.iter_fragments():
+                if frag._mirrors:
+                    hbm.setdefault(iname, set()).add(shard)
+                elif frag._stage is not None or frag._packed is not None:
+                    host.setdefault(iname, set()).add(shard)
+        out = {}
+        cap = self.RESIDENCY_MAX_SHARDS
+        for iname in set(hbm) | set(host):
+            h = sorted(hbm.get(iname, set()))
+            st = sorted(host.get(iname, set()) - hbm.get(iname, set()))
+            entry = {"hbm": h[:cap], "host": st[:cap]}
+            if len(h) > cap or len(st) > cap:
+                entry["truncated"] = True
+            out[iname] = entry
+        self._residency_cache = (now, out)
+        return out
+
+    def local_load(self) -> dict:
+        """This node's admission depth, piggybacked on /status and
+        /internal/query responses for the router's load scores."""
+        srv = self._server
+        if srv is None:
+            return {"inFlight": 0, "queued": 0}
+        a = srv.admission.snapshot()
+        b = srv.admission_internal.snapshot()
+        return {"inFlight": a["inUse"] + b["inUse"],
+                "queued": a["waiting"] + b["waiting"]}
 
     # -- peer data-version registry (result-cache keying) ------------------
 
@@ -1145,9 +1374,21 @@ class Cluster:
     def forget_index_shards(self, index: str):
         """Drop remembered remote shard availability for a deleted
         index (both deletion paths — local API and cluster message —
-        funnel here)."""
+        funnel here).  Overlay entries for the index go with it, WITH an
+        epoch bump when any existed: every live node applies the same
+        delete so they bump in lockstep, and a node that was DOWN (stale
+        entries, stale epoch) is then behind the coordinator and gets
+        the probe's overlay re-push — without the bump its stale entries
+        would be unrepairable, and a recreated index would route reads
+        at a phantom overlay owner."""
         with self._shards_lock:
             self._remote_shards.pop(index, None)
+        with self._overlay_lock:
+            dropped = [k for k in self._overlay if k[0] == index]
+            for key in dropped:
+                del self._overlay[key]
+            if dropped:
+                self.overlay_epoch += 1
 
     def _available_shards(self, index: str,
                           mark_down: bool = True,
@@ -1404,6 +1645,19 @@ class Cluster:
                 args = (self.by_id[nid].host, index, calls, nshards)
                 if deadline_s is not None:
                     args += (deadline_s,)
+                # router feed: coordinator-observed in-flight depth and
+                # the per-shard load counters the balancer watches
+                self.router.note_dispatch(nid, len(nshards))
+                self.load_tracker.note(index, nshards, nid)
+
+                # the router's RTT sample is timed INSIDE the pool
+                # worker: the collection-loop elapsed below also counts
+                # local execution and earlier peers' result waits, which
+                # would systematically inflate remote scores vs local
+                def timed_rpc(*a, _fn=self.client.query_calls):
+                    t = time.perf_counter()
+                    return _fn(*a), time.perf_counter() - t
+
                 # task(): the pool worker re-installs this thread's trace
                 # context and runs the RPC under a per-peer client span —
                 # the injected header then carries that span's id, so the
@@ -1411,20 +1665,30 @@ class Cluster:
                 futures[nid] = (nshards, time.perf_counter(),
                                 self._pool.submit(
                                     GLOBAL_TRACER.task(
-                                        self.client.query_calls,
+                                        timed_rpc,
                                         name=f"cluster.rpc {nid}",
                                         host=self.by_id[nid].host),
                                     *args))
             if local_shards is not None:
-                with stats.timer("cluster.multi.local_exec"), \
-                        qprof.stage("local_exec"):
-                    for i, r in enumerate(self.api.executor.execute(
-                            index, q, local_shards, translate=False)):
-                        out[i].append(r)
+                self.router.note_dispatch(self.node_id, len(local_shards))
+                self.load_tracker.note(index, local_shards, self.node_id)
+                t_local = time.perf_counter()
+                try:
+                    with stats.timer("cluster.multi.local_exec"), \
+                            qprof.stage("local_exec"):
+                        for i, r in enumerate(self.api.executor.execute(
+                                index, q, local_shards, translate=False)):
+                            out[i].append(r)
+                finally:
+                    self.router.note_done(
+                        self.node_id, time.perf_counter() - t_local)
             pending = []
             for nid, (nshards, t0, fut) in futures.items():
                 try:
-                    res, exec_s, peer_gens, peer_quarantined = fut.result()
+                    (res, exec_s, peer_gens, peer_quarantined,
+                     peer_load), rtt = fut.result()
+                    self.router.note_done(nid, rtt)
+                    self.router.note_query_load(nid, peer_load)
                     if peer_quarantined:
                         # peer answered with quarantined fragments serving
                         # empty: surface it on THIS response (consumed on
@@ -1449,8 +1713,12 @@ class Cluster:
                 except CircuitOpenError as e:
                     # fail-fast: the peer's breaker is open (N consecutive
                     # transport failures) — treat like a dead node, not an
-                    # application error from a live one
+                    # application error from a live one.  (The router
+                    # pre-skips open breakers, so this only fires when
+                    # EVERY candidate was open or the breaker opened
+                    # mid-flight.)
                     last_err = e
+                    self.router.note_done(nid, None, ok=False)
                     self._mark_down(nid)
                     exclude.add(nid)
                     pending.extend(nshards)
@@ -1459,10 +1727,12 @@ class Cluster:
                     # application-level failure must not poison
                     # membership — just retry these shards on a replica
                     last_err = e
+                    self.router.note_done(nid, None, ok=False)
                     exclude.add(nid)
                     pending.extend(nshards)
                 except Exception as e:
                     last_err = e
+                    self.router.note_done(nid, None, ok=False)
                     self._mark_down(nid)
                     exclude.add(nid)
                     pending.extend(nshards)
@@ -1523,25 +1793,19 @@ class Cluster:
                                          translate=False)[0]
 
     def _ready_owner_order(self, index: str, shard: int) -> list[str]:
-        owners = self.placement.shard_nodes(index, shard)
+        owners = self.shard_owner_nodes(index, shard)
         ready = [o for o in owners if self.by_id[o].state == NODE_READY]
         return ready or owners
 
     def _group_shards(self, index: str,
                       shards: list[int],
                       exclude: set[str] = frozenset()) -> dict[str, list]:
-        """shard -> preferred executor node: self if it owns the shard,
-        else the first READY owner (executor.go:2435 shardsByNode)."""
-        groups: dict[str, list[int]] = {}
-        for s in shards:
-            order = [o for o in self._ready_owner_order(index, s)
-                     if o not in exclude]
-            if not order:
-                raise ClusterError(
-                    f"no available node for shard {s} of {index!r}")
-            target = self.node_id if self.node_id in order else order[0]
-            groups.setdefault(target, []).append(s)
-        return groups
+        """shard -> executor node, chosen by the read router
+        (parallel/routing.py): ``read-routing=primary`` reproduces the
+        legacy grouping — self if it owns the shard, else the first
+        READY owner (executor.go:2435 shardsByNode) — while
+        ``round-robin``/``loaded`` spread reads across replicas."""
+        return self.router.group_shards(index, shards, exclude)
 
     def _execute_topn_extras(self, index: str, c: Call, shards: list[int]):
         """TopN with tanimoto/attr filtering, finalized GLOBALLY at the
@@ -1675,7 +1939,7 @@ class Cluster:
         if not isinstance(col, int) or isinstance(col, bool):
             return self._local_exec(index, c, [])
         shard = col // SHARD_WIDTH
-        owners = self.placement.shard_nodes(index, shard)
+        owners = self.shard_owner_nodes(index, shard)
         self._require_ready(owners, f"write shard {shard} of {index!r}")
         self.note_peer_write(index, owners)
         futures = []
@@ -1695,20 +1959,19 @@ class Cluster:
                                  shards: list[int]):
         """Store/ClearRow touch every owned fragment on every node."""
         involved = [n.id for n in self.nodes
-                    if self.placement.owned_shards(n.id, index, shards)]
+                    if self.owned_shards(n.id, index, shards)]
         self._require_ready(involved, f"{c.name} on {index!r}")
         self.note_peer_write(index, involved)
         changed = False
         futures = []
         for n in self.nodes:
-            owned = self.placement.owned_shards(n.id, index, shards)
+            owned = self.owned_shards(n.id, index, shards)
             if not owned or n.id == self.node_id:
                 continue
             futures.append(self._pool.submit(
                 GLOBAL_TRACER.task(self.client.query_call),
                 n.host, index, c, owned))
-        local_owned = self.placement.owned_shards(self.node_id, index,
-                                                  shards)
+        local_owned = self.owned_shards(self.node_id, index, shards)
         if local_owned:
             changed = bool(self._local_exec(index, c, local_owned))
         for f in futures:
@@ -1872,6 +2135,8 @@ class Cluster:
             self._apply_resize_fetch(msg)
         elif t == "resize-complete":
             self._apply_resize_complete(msg)
+        elif t == "placement-overlay":
+            self._apply_overlay(msg)
         else:
             raise ClusterError(f"unknown cluster message type {t!r}")
 
@@ -1885,7 +2150,7 @@ class Cluster:
         shards = cols // SHARD_WIDTH
         by_node: dict[str, list[int]] = {}
         for s in np.unique(shards):
-            owners = self.placement.shard_nodes(index, int(s))
+            owners = self.shard_owner_nodes(index, int(s))
             self._require_ready(owners, f"import shard {int(s)}")
             for nid in owners:
                 by_node.setdefault(nid, []).append(int(s))
@@ -1908,8 +2173,7 @@ class Cluster:
                 if f is not None:
                     f.remote_available_shards.update(
                         s for s in nshards
-                        if not self.placement.owns_shard(
-                            self.node_id, index, s))
+                        if not self.owns_shard(self.node_id, index, s))
         if local_payload is not None:
             self.api.apply_import_local(index, field, local_payload)
         for fut in futures:
@@ -1940,8 +2204,8 @@ class Cluster:
         Single-view imports (the overwhelmingly common shape) ship RAW
         over /internal/import-roaring — no base64, no JSON envelope;
         multi-view imports keep the legacy JSON forward."""
-        self.note_peer_write(index, self.placement.shard_nodes(index, shard))
-        for nid in self.placement.shard_nodes(index, shard):
+        self.note_peer_write(index, self.shard_owner_nodes(index, shard))
+        for nid in self.shard_owner_nodes(index, shard):
             if nid == self.node_id:
                 self.api.apply_import_roaring_local(index, field, shard,
                                                     views, clear)
@@ -2014,7 +2278,7 @@ class Cluster:
                         f"shard poll for {i} from {nid}", e))
                 for fname, f in list(idx.fields.items()):
                     for s in shards:
-                        owners = self.placement.shard_nodes(index_name, s)
+                        owners = self.shard_owner_nodes(index_name, s)
                         if self.node_id not in owners:
                             continue
                         for vname in list(f.views) or ["standard"]:
@@ -2044,7 +2308,7 @@ class Cluster:
                 list(self.holder.iter_fragments()):
             if frag.quarantined is None:
                 continue
-            owners = self.placement.shard_nodes(iname, shard)
+            owners = self.shard_owner_nodes(iname, shard)
             for nid, host in self._ready_peer_hosts(owners):
                 try:
                     blob = self.client.fragment_fetch(
@@ -2310,6 +2574,14 @@ class Cluster:
         self.placement = Placement([n.id for n in self.nodes],
                                    replica_n=self.replica_n,
                                    hasher=self.placement.hasher)
+        # placement overlay rides the topology file: a restarted overlay
+        # owner must keep serving (and receiving writes for) its extra
+        # shards; a node restarted with wiped state converges via the
+        # probe's overlay-epoch re-push instead
+        self.overlay_epoch = int(data.get("overlayEpoch", 0))
+        self._overlay = {
+            (i, int(s)): [nid for nid in extras if nid in self.by_id]
+            for i, s, extras in data.get("overlay", [])}
 
     def _save_topology(self):
         from ..utils.durable import durable_replace, fsync_file
@@ -2320,7 +2592,9 @@ class Cluster:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"epoch": self.epoch, "replicaN": self.replica_n,
-                       "membership": self._membership()}, f)
+                       "membership": self._membership(),
+                       "overlayEpoch": self.overlay_epoch,
+                       "overlay": self._overlay_wire()}, f)
             # a crash must not leave a node on the PRE-resize membership
             # after it acked the new one (split-brain on restart)
             fsync_file(f)
@@ -2677,6 +2951,17 @@ class Cluster:
                                    replica_n=self.replica_n,
                                    hasher=self.placement.hasher)
         self.epoch = msg_epoch
+        # a membership resize reshuffles jump-hash placement wholesale:
+        # the overlay (tuned for the OLD placement) is dropped on every
+        # node and the balancer re-detects hot spots under the new
+        # placement.  The epoch bump is UNCONDITIONAL so every node
+        # moves in lockstep regardless of its table content — a node
+        # carrying stale entries (missed a delete-index) bumping while a
+        # clean coordinator did not would end up AHEAD and silently
+        # reject the coordinator's next legitimate overlay broadcast
+        with self._overlay_lock:
+            self._overlay = {}
+            self.overlay_epoch += 1
         self._save_topology()
         self.state = STATE_NORMAL
         self._update_state()
@@ -2712,7 +2997,7 @@ class Cluster:
             for f in list(idx.fields.values()):
                 for v in list(f.views.values()):
                     for shard in list(v.fragments):
-                        if self.node_id not in self.placement.shard_nodes(
+                        if self.node_id not in self.shard_owner_nodes(
                                 index_name, shard):
                             frag = v.fragments.pop(shard)
                             try:
@@ -2725,8 +3010,12 @@ class Cluster:
 
     # -- internal HTTP routes (handler.go:302-314 /internal/*) -------------
 
-    def register_routes(self, router):
+    def register_routes(self, router, server=None):
         cluster = self
+        if server is not None:
+            # load piggybacks (local_load) report this server's
+            # admission pools
+            self._server = server
 
         def internal_query(req, args):
             from ..cache.results import gen_summary
@@ -2752,6 +3041,10 @@ class Cluster:
                     args["index"]))
                 if nq:
                     out["quarantined"] = nq
+                # admission depth piggyback (parallel/routing.py): every
+                # answered sub-query refreshes the coordinator's load
+                # view of this node, like the gen summaries above
+                out["load"] = cluster.local_load()
                 # span summaries piggyback like the gen summaries: the
                 # handler collected this request's finished spans (and
                 # its own in-flight HTTP span) so the coordinator can

@@ -356,8 +356,9 @@ def build_router(api: API, server=None) -> Router:
                     else None
                 by_node: dict[str, list[int]] = {}
                 for s in np.unique(shards):
-                    for nid in cluster.placement.shard_nodes(index,
-                                                             int(s)):
+                    # overlay-aware owners: a balancer-added replica
+                    # receives ingest writes like any other owner
+                    for nid in cluster.shard_owner_nodes(index, int(s)):
                         by_node.setdefault(nid, []).append(int(s))
                 cluster.note_peer_write(index, by_node)
                 for nid, nshards in by_node.items():
@@ -375,8 +376,7 @@ def build_router(api: API, server=None) -> Router:
                     if f_obj is not None:
                         f_obj.remote_available_shards.update(
                             s for s in nshards
-                            if not cluster.placement.owns_shard(
-                                local_id, index, s))
+                            if not cluster.owns_shard(local_id, index, s))
                     if fwd_bytes[host] >= FWD_FLUSH_BYTES:
                         ship(host)
             for host in list(fwd):
@@ -500,6 +500,16 @@ def build_router(api: API, server=None) -> Router:
         if server is not None and getattr(server, "cluster",
                                           None) is not None:
             out["breakers"] = server.cluster.client.breaker_snapshot()
+            # elastic serving (docs/cluster.md "Read routing &
+            # rebalancing"): per-peer routing state (EWMA RTT, in-flight,
+            # residency summary age, breaker state), the placement
+            # overlay, and the balancer's hot-shard view
+            cl = server.cluster
+            out["cluster"] = {
+                "routing": cl.router.snapshot(),
+                "overlay": cl.overlay_snapshot(),
+                "balancer": cl.balancer.snapshot(),
+            }
         from ..utils.faults import FAULTS
         armed = FAULTS.snapshot()
         if armed:

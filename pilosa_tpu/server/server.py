@@ -137,6 +137,28 @@ class Config:
     # Consecutive SOFT probe failures (timeouts/resets — refused
     # connections flip immediately) before NODE_DOWN.
     health_down_threshold: int = 2
+    # -- elastic serving (docs/cluster.md "Read routing & rebalancing") ----
+    # Read fan-out replica policy: "primary" pins reads to the jump-hash
+    # primary (the pre-routing behavior, byte-for-byte), "round-robin"
+    # rotates among READY owners, "loaded" scores replicas by EWMA RTT x
+    # queue pressure with a residency discount (parallel/routing.py).
+    read_routing: str = "loaded"
+    # Prefer the replica that holds the queried shards HBM-resident or
+    # host-staged (residency tiers piggybacked on /status probes); off =
+    # pure load scores.
+    residency_routing: bool = True
+    # Hot-shard balancer (parallel/balancer.py): the coordinator widens a
+    # sustained-hot shard's replica set by one underloaded node (resize-
+    # fetch copy + epoch-gated placement-overlay broadcast).  Off
+    # (default) keeps placement exactly static jump-hash.
+    balancer: bool = False
+    # Seconds between balancer ticks (also the shard-load counter
+    # window).
+    balancer_interval: float = 30.0
+    # A shard is "hot" when its dispatch count over the window exceeds
+    # this multiple of the mean across active shards (plus an absolute
+    # floor; balancer.HOT_MIN_COUNT).
+    hot_shard_threshold: float = 4.0
     # Failpoint spec armed at startup (utils/faults.py syntax); empty =
     # nothing armed.  For chaos tests and game-days only.
     failpoints: str = ""
@@ -257,6 +279,13 @@ class Config:
             "PILOSA_TPU_DRAIN_SECONDS": ("drain_seconds", float),
             "PILOSA_TPU_HEALTH_DOWN_THRESHOLD": ("health_down_threshold",
                                                  int),
+            "PILOSA_TPU_READ_ROUTING": ("read_routing", str),
+            "PILOSA_TPU_RESIDENCY_ROUTING": (
+                "residency_routing", lambda s: s != "false"),
+            "PILOSA_TPU_BALANCER": ("balancer", lambda s: s == "true"),
+            "PILOSA_TPU_BALANCER_INTERVAL": ("balancer_interval", float),
+            "PILOSA_TPU_HOT_SHARD_THRESHOLD": ("hot_shard_threshold",
+                                               float),
             "PILOSA_TPU_FAILPOINTS": ("failpoints", str),
             "PILOSA_TPU_WAL_CRC": ("wal_crc", lambda s: s != "false"),
             "PILOSA_TPU_QUARANTINE_ON_CORRUPTION": (
@@ -317,6 +346,11 @@ class Config:
             "breaker-threshold": "breaker_threshold",
             "drain-seconds": "drain_seconds",
             "health-down-threshold": "health_down_threshold",
+            "read-routing": "read_routing",
+            "residency-routing": "residency_routing",
+            "balancer": "balancer",
+            "balancer-interval": "balancer_interval",
+            "hot-shard-threshold": "hot_shard_threshold",
             "failpoints": "failpoints",
             "wal-crc": "wal_crc",
             "quarantine-on-corruption": "quarantine_on_corruption",
@@ -415,6 +449,11 @@ class Server:
                 health_down_threshold=self.config.health_down_threshold,
                 breaker_threshold=self.config.breaker_threshold,
                 stats=self.stats,
+                read_routing=self.config.read_routing,
+                residency_routing=self.config.residency_routing,
+                balancer=self.config.balancer,
+                balancer_interval=self.config.balancer_interval,
+                hot_shard_threshold=self.config.hot_shard_threshold,
             )
             if not self.cluster.is_coordinator:
                 # key translation lives on the coordinator; replicas route
@@ -532,7 +571,7 @@ class Server:
 
     def register_internal_routes(self, router):
         if self.cluster is not None:
-            self.cluster.register_routes(router)
+            self.cluster.register_routes(router, server=self)
 
     def open(self):
         """(reference server.go:417 Open)"""
@@ -761,6 +800,32 @@ class Server:
         self.stats.gauge("ingest.merge_backlog", ing["pendingBytes"])
         self.stats.gauge("ingest.folds", ing["folds"])
         self.update_device_gauges()
+        self.update_routing_gauges()
+
+    def update_routing_gauges(self):
+        """Per-peer routing-state gauges (docs/cluster.md "Read routing
+        & rebalancing"), refreshed at scrape time like the storage
+        gauges: the operator's answer to "why is this replica not taking
+        reads" must reflect now, not the last metric poll."""
+        if self.cluster is None:
+            return
+        for nid, g in self.cluster.router.peer_states():
+            self.stats.gauge(f"cluster.peer.{nid}.ewma_rtt_ms",
+                             g["ewma_rtt_ms"])
+            self.stats.gauge(f"cluster.peer.{nid}.inflight",
+                             g["inflight"])
+            self.stats.gauge(f"cluster.peer.{nid}.queued", g["queued"])
+            self.stats.gauge(f"cluster.peer.{nid}.residency_age_s",
+                             g["residency_age_s"])
+            self.stats.gauge(f"cluster.peer.{nid}.breaker_open",
+                             g["breaker_open"])
+            self.stats.gauge(f"cluster.peer.{nid}.dispatches",
+                             g["dispatches"])
+        snap = self.cluster.overlay_snapshot()
+        self.stats.gauge("cluster.overlay_entries", len(snap["entries"]))
+        self.stats.gauge("cluster.overlay_epoch", snap["epoch"])
+        self.stats.gauge("cluster.balancer_handoffs",
+                         self.cluster.balancer.handoffs)
 
     def update_device_gauges(self):
         """Compile-registry + launch-ledger gauges (docs/observability.md

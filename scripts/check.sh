@@ -34,9 +34,15 @@ python -m pilosa_tpu.analysis
 # reason: the single-program path serves every read request by
 # default, and a lowering bug corrupts answers silently — the
 # three-leg byte-identity suite is hygiene, not a nicety.
+# The elastic-serving suite (docs/cluster.md "Read routing &
+# rebalancing") rides as well: the loaded-vs-primary differential is a
+# byte-identity guarantee (a routing bug would serve wrong answers from
+# a stale replica silently), and the balancer handoff test covers the
+# overlay epoch protocol every node's ownership view depends on.
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' -p no:cacheprovider \
     tests/test_durability.py tests/test_crash.py tests/test_containers.py \
-    tests/test_device_obs.py tests/test_ingest.py tests/test_wholequery.py
+    tests/test_device_obs.py tests/test_ingest.py tests/test_wholequery.py \
+    tests/test_routing.py
 
 # committed bytecode/cache artifacts must never land in the tree (shell
 # stays the right layer for a git-index check)

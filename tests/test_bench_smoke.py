@@ -65,6 +65,14 @@ def test_bench_smoke_resident_and_budgeted():
     assert data["http_batch"]["fused_launches"] > 0
     assert data["http_batch"]["qps_on"] > 0 \
         and data["http_batch"]["qps_off"] > 0
+    # elastic-routing leg (docs/cluster.md "Read routing &
+    # rebalancing"): loaded routing answered byte-identically to
+    # primary-pinned on the skew corpus (asserted in bench.py), the hot
+    # shards were served by more than one node, and both modes measured
+    rt = data["routing"]
+    assert rt["answers_identical"] is True
+    assert rt["hot_shard_nodes"] > 1
+    assert rt["qps_loaded"] > 0 and rt["qps_primary"] > 0
     # observability leg (docs/observability.md): profile-off serving
     # stays within 5% of the batching leg (asserted in bench.py) and
     # profile-on returned a populated stage tree + resolvable trace

@@ -338,18 +338,21 @@ def test_breaker_opens_fails_fast_and_recovers(tmp_path):
         snap = cl.client.breaker_snapshot()
         assert snap[peer_host]["state"] == "open"
         FAULTS.disarm()  # node1 is healthy again, but the breaker is
-        #                  still open (cooldown) -> queries fail FAST to
-        #                  the replica instead of waiting out a timeout
+        #                  still open (cooldown) -> the read router skips
+        #                  it BEFORE dispatch (routing.breaker_skip) and
+        #                  the replica answers instead of waiting out a
+        #                  timeout
         t0 = time.perf_counter()
         [got] = _req(p0, "POST", "/index/cb/query",
                      "Count(Row(f=1))")["results"]
         assert time.perf_counter() - t0 < 5.0
         assert got == want
         assert cl.by_id["node1"].state == "DOWN"  # breaker agrees
-        assert cl.client.breaker_snapshot()[peer_host]["fastFails"] >= 1
-        # breaker state surfaces at /debug/vars
+        # breaker + routing state surface at /debug/vars
         dv = _req(p0, "GET", "/debug/vars")
         assert dv["breakers"][peer_host]["openedTotal"] >= 1
+        assert dv["counts"].get("routing.breaker_skip", 0) >= 1
+        assert dv["cluster"]["routing"]["breakerSkips"] >= 1
         # recovery: the health probe is ALWAYS admitted as the half-open
         # trial (no cooldown wait); success closes the breaker + READY
         cl.probe_peers()
