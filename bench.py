@@ -934,6 +934,158 @@ def run_routing_smoke(rng) -> dict:
     return out
 
 
+def _chaos_leg(rng, *, n_shards=8, n_base=30, n_fault=12,
+               min_delay_s=0.3):
+    """Tail-tolerance leg (docs/robustness.md "Tail-tolerant fan-out"):
+    3 real server nodes with the two replicas dialed through
+    ChaosProxies (utils/netchaos.py — REAL sockets, not failpoints),
+    read-routing pinned to primary so the straggler keeps being
+    targeted, hedge-delay-ms fixed at 40.  Measures intersect/TopN
+    latency three ways on identical data: no fault (baseline), one
+    replica's responses delayed >= 5x the baseline p99 with hedging ON,
+    and the same straggler with hedging OFF.  Asserts all three runs
+    answer byte-identically; the hedged-vs-baseline p99 ratio is the
+    headline number."""
+    import http.client
+    import socket
+    import tempfile
+
+    from pilosa_tpu.core import SHARD_WIDTH
+    from pilosa_tpu.server import Config, Server
+    from pilosa_tpu.utils.netchaos import ChaosProxy
+
+    socks = []
+    for _ in range(3):
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        socks.append(s)
+    binds = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    proxies = {}
+    hosts = [f"localhost:{binds[0]}"]
+    for i in (1, 2):
+        proxies[f"node{i}"] = ChaosProxy("localhost", binds[i])
+        hosts.append(proxies[f"node{i}"].address)
+    servers = []
+
+    def post(port, path, body: bytes, timeout=600):
+        conn = http.client.HTTPConnection("localhost", port,
+                                          timeout=timeout)
+        conn.request("POST", path, body=body)
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        if resp.status != 200:
+            raise RuntimeError(f"{path}: {resp.status} {data[:200]!r}")
+        return json.loads(data)
+
+    try:
+        for i, p in enumerate(binds):
+            srv = Server(Config(
+                data_dir=tempfile.mkdtemp(prefix=f"ptpu_chaos_{i}_"),
+                bind=f"localhost:{p}", node_id=f"node{i}",
+                cluster_hosts=hosts, replica_n=2,
+                anti_entropy_interval=0,
+                read_routing="primary", hedge_delay_ms=40.0))
+            servers.append(srv)
+            srv.open()
+        coord = servers[0].cluster
+        # an index whose placement gives node0 some — but not all —
+        # replica sets, so a remote straggler actually owns primaries
+        def remote_owned(name):
+            return [s for s in range(n_shards)
+                    if "node0" not in
+                    coord.placement.shard_nodes(name, s)]
+        index = next(name for name in (f"chaos{i}" for i in range(64))
+                     if 0 < len(remote_owned(name)) < n_shards)
+        p0 = binds[0]
+        post(p0, f"/index/{index}", b"{}")
+        post(p0, f"/index/{index}/field/a", b"{}")
+        cols = np.unique(rng.integers(0, n_shards * SHARD_WIDTH,
+                                      size=5000))
+        rows = rng.integers(0, 8, size=cols.size)
+        post(p0, f"/index/{index}/field/a/import", json.dumps({
+            "rowIDs": rows.tolist(), "columnIDs": cols.tolist()}).encode())
+        corpus = ["Count(Intersect(Row(a=1), Row(a=2)))",
+                  "TopN(a, n=0)", "Count(Row(a=3))", "Row(a=4)"]
+        for q in corpus:  # compile warm-up
+            post(p0, f"/index/{index}/query", q.encode(), timeout=1800)
+        # primary-policy target of a node0-less shard = first owner in
+        # placement order (every node is READY here)
+        straggler = coord.placement.shard_nodes(
+            index, remote_owned(index)[0])[0]
+
+        def run(n):
+            lats, answers = [], []
+            for i in range(n):
+                q = corpus[i % len(corpus)]
+                t0 = time.perf_counter()
+                out = post(p0, f"/index/{index}/query", q.encode())
+                lats.append(time.perf_counter() - t0)
+                if i < len(corpus):
+                    answers.append(out["results"])
+            lats.sort()
+            return lats[max(int(len(lats) * 0.99) - 1, 0)], answers
+
+        p99_base, ans_base = run(n_base)
+        delay = max(min_delay_s, 5 * p99_base)
+        counts0 = servers[0].api.stats.snapshot()["counts"]
+        hedges0 = counts0.get("cluster.hedges", 0)
+        proxies[straggler].configure(f"down=latency:{delay}")
+        p99_hedged, ans_hedged = run(n_fault)
+        coord.hedge_reads = False
+        p99_unhedged, ans_unhedged = run(n_fault)
+        coord.hedge_reads = True
+        proxies[straggler].heal()
+        counts1 = servers[0].api.stats.snapshot()["counts"]
+        assert ans_hedged == ans_base and ans_unhedged == ans_base, \
+            "chaos leg answers diverged from the no-fault baseline"
+        return {
+            "answers_identical": True,
+            "injected_delay_ms": round(delay * 1e3, 1),
+            "p99_base_ms": round(p99_base * 1e3, 1),
+            "p99_hedged_ms": round(p99_hedged * 1e3, 1),
+            "p99_unhedged_ms": round(p99_unhedged * 1e3, 1),
+            "hedged_vs_base": round(p99_hedged / p99_base, 2)
+            if p99_base else None,
+            "unhedged_vs_base": round(p99_unhedged / p99_base, 2)
+            if p99_base else None,
+            "hedges": counts1.get("cluster.hedges", 0) - hedges0,
+            "hedge_wins": counts1.get("cluster.hedge_wins", 0)
+            - counts0.get("cluster.hedge_wins", 0),
+        }
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            # lint: allow(swallowed-exception) — bench teardown; the
+            # server may already be down and the leg's numbers are in
+            except Exception:
+                pass
+        for proxy in proxies.values():
+            proxy.close()
+
+
+def bench_chaos(rng):
+    """Main-bench tail-tolerance leg: straggler p99 with hedging on vs
+    off at full query counts (see _chaos_leg)."""
+    return _chaos_leg(rng, n_base=40, n_fault=16)
+
+
+def run_chaos_smoke(rng) -> dict:
+    """Chaos leg of --smoke (docs/robustness.md): small query counts;
+    asserts hedging actually fired and rescued the tail — hedged p99
+    under the injected delay, unhedged p99 bound BY it — with answers
+    byte-identical across all three runs (asserted in _chaos_leg)."""
+    out = _chaos_leg(rng, n_base=20, n_fault=8, min_delay_s=0.3)
+    assert out["hedges"] > 0, "straggler never triggered a hedge"
+    assert out["p99_hedged_ms"] < out["injected_delay_ms"], out
+    assert out["p99_unhedged_ms"] >= 0.8 * out["injected_delay_ms"], out
+    assert out["p99_hedged_ms"] < out["p99_unhedged_ms"], out
+    return out
+
+
 # -- numpy oracle baselines (single-thread reference-algorithm stand-in) ----
 
 def _np_frag(holder, index, field, view=None):
@@ -2021,6 +2173,7 @@ def run_smoke():
     out["wholequery"] = run_wholequery_smoke(
         np.random.default_rng(SEED + 9))
     out["routing"] = run_routing_smoke(np.random.default_rng(SEED + 10))
+    out["chaos"] = run_chaos_smoke(np.random.default_rng(SEED + 11))
     out["compressed"] = run_compressed_smoke(np.random.default_rng(SEED + 6))
     out["ingest"] = run_ingest_smoke(np.random.default_rng(SEED + 8))
     out["cache"] = run_cache_smoke(np.random.default_rng(SEED + 3))
@@ -2109,6 +2262,16 @@ def main():
         print(f"routing config failed: {e!r}", file=sys.stderr)
         traceback.print_exc()
         routing_leg = None
+
+    # tail-tolerance config (docs/robustness.md "Tail-tolerant
+    # fan-out"): ChaosProxy straggler p99 with hedging on vs off
+    try:
+        chaos_leg = bench_chaos(np.random.default_rng(SEED + 11))
+    except Exception as e:
+        import traceback
+        print(f"chaos config failed: {e!r}", file=sys.stderr)
+        traceback.print_exc()
+        chaos_leg = None
 
     # concurrent-HTTP dynamic-batching config (docs/batching.md): the
     # served single-query path, dispatch-batch on vs off
@@ -2212,6 +2375,8 @@ def main():
         configs["9_whole_query"] = wq_leg
     if routing_leg:
         configs["10_elastic_routing"] = routing_leg
+    if chaos_leg:
+        configs["11_tail_tolerance_chaos"] = chaos_leg
 
     print(json.dumps({
         "metric": "engine_intersect8_count_qps_1M_cols",

@@ -460,6 +460,13 @@ max-op-n = 10000
 # queue-timeout = 0.5      # seconds to wait for a slot before 503
 # breaker-threshold = 5    # consecutive peer failures -> circuit open
 # drain-seconds = 5        # graceful-drain budget on shutdown
+# tail-tolerant reads (docs/robustness.md "Tail-tolerant fan-out")
+# hedge-reads = true       # speculative duplicate of straggling read
+#                          # RPCs; first answer wins, writes never hedge
+# hedge-delay-ms = 0       # 0 = derive from the router's EWMA RTT
+# partial-results = false  # server default for ?partialResults: serve
+#                          # reads with unservable shards, naming the
+#                          # missing shards in the degraded object
 # durability & recovery (docs/robustness.md)
 # wal-crc = true           # CRC-frame new WAL files (torn-tail recovery)
 # quarantine-on-corruption = true  # corrupt fragment -> quarantine +
@@ -534,6 +541,9 @@ def cmd_config(args) -> int:
     print(f"breaker-threshold = {cfg.breaker_threshold}")
     print(f"drain-seconds = {cfg.drain_seconds}")
     print(f"health-down-threshold = {cfg.health_down_threshold}")
+    print(f"hedge-reads = {str(cfg.hedge_reads).lower()}")
+    print(f"hedge-delay-ms = {cfg.hedge_delay_ms}")
+    print(f"partial-results = {str(cfg.partial_results).lower()}")
     print(f"read-routing = {q(cfg.read_routing)}")
     print(f"residency-routing = {str(cfg.residency_routing).lower()}")
     print(f"balancer = {str(cfg.balancer).lower()}")

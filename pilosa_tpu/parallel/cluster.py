@@ -37,7 +37,8 @@ import os
 import threading
 import time
 import zlib
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import wait as futures_wait
 from typing import Any
 
 import numpy as np
@@ -611,8 +612,10 @@ class InternalClient:
             raise ClusterError(
                 f"{host} import-roaring: {status} {msg}")
 
-    def available_shards(self, host: str, index: str) -> list[int]:
-        out = self._json(host, "GET", f"/internal/index/{index}/shards")
+    def available_shards(self, host: str, index: str,
+                         timeout: float | None = None) -> list[int]:
+        out = self._json(host, "GET", f"/internal/index/{index}/shards",
+                         timeout=timeout)
         return out.get("shards", [])
 
     def fragment_blocks(self, host: str, index: str, field: str, view: str,
@@ -843,7 +846,9 @@ class Cluster:
                  residency_routing: bool = True,
                  balancer: bool = False,
                  balancer_interval: float = 30.0,
-                 hot_shard_threshold: float = 4.0):
+                 hot_shard_threshold: float = 4.0,
+                 hedge_reads: bool = True,
+                 hedge_delay_ms: float = 0.0):
         self.nodes = [Node(f"node{i}", h) for i, h in enumerate(hosts)]
         self.by_id = {n.id: n for n in self.nodes}
         if node_id not in self.by_id:
@@ -938,6 +943,18 @@ class Cluster:
         self.balancer = HotShardBalancer(
             self, self.load_tracker, threshold=hot_shard_threshold,
             stats=stats)
+        # Tail-tolerant fan-out (docs/robustness.md "Tail-tolerant
+        # fan-out"): hedged reads fire a speculative duplicate of a
+        # straggling shard-group RPC at the next-best replica; safe
+        # because every call through _fan_out_multi is an idempotent
+        # internal read (writes fan out through their own replica-
+        # synchronous paths and are NEVER hedged).  hedge_delay_ms = 0
+        # derives the delay from the router's EWMA RTT.
+        self.hedge_reads = bool(hedge_reads)
+        self.hedge_delay_ms = float(hedge_delay_ms)
+        # structured-event sink (cluster.fanout_failed); the Server
+        # wires its logger in, standalone clusters stay silent
+        self.logger = None
         # residency-summary TTL cache (walking every fragment per /status
         # probe would make probes O(fragments); 2s staleness is far under
         # RESIDENCY_TTL_S)
@@ -1392,7 +1409,8 @@ class Cluster:
 
     def _available_shards(self, index: str,
                           mark_down: bool = True,
-                          on_error=None) -> list[int]:
+                          on_error=None,
+                          patient: bool = False) -> list[int]:
         """Union of local + peer available shards.  The reference gossips
         per-field available-shard bitmaps (field.go:263); with static
         membership we ask peers directly and fold the answer into
@@ -1401,31 +1419,105 @@ class Cluster:
         /internal/shards/max): a transient peer timeout there must not
         flip the cluster DEGRADED.  ``on_error``: optional
         ``(node_id, exc)`` callback — the anti-entropy pass surfaces
-        these swallowed failures as DATA (a peer poll failing here marks
-        the node DOWN, which silently empties every later peer loop in
-        the pass; without the callback the whole pass would look like a
-        clean no-op success)."""
+        these swallowed failures as DATA (a peer poll failing here used
+        to mark the node DOWN, which silently empties every later peer
+        loop in the pass; without the callback the whole pass would look
+        like a clean no-op success).
+
+        A poll failure routes through the PROBER's consecutive-miss
+        accounting (_note_probe_failure) rather than marking the peer
+        DOWN outright: one transient discovery timeout used to flip a
+        READY node DOWN and silently shrink every later fan-out wave,
+        bypassing the health-down-threshold discipline every other
+        failure path honors.  A successful poll clears the miss streak
+        exactly like a successful probe.
+
+        ``patient=True`` disables the hedge-derived straggler grace:
+        anti-entropy and resize need the COMPLETE answer (a shard
+        missing from the remembered map would be silently skipped by a
+        sync pass, or omitted from a resize's fetch lists — a one-shot
+        data-placement gap), so they wait out slow polls; only the
+        query path trades completeness for bounded discovery time."""
         idx = self.holder.index(index)
         shards = set(idx.available_shards()) if idx is not None else set()
-        for n in self.peers():
-            if n.state != NODE_READY:
-                continue
+        peers = [n for n in self.peers() if n.state == NODE_READY]
+        # Polls run CONCURRENTLY with a bounded, deadline-clamped
+        # timeout: this discovery step precedes every coordinator
+        # fan-out, so a straggling peer must cost ONE bounded poll of
+        # wall clock — not a serial sweep of default socket timeouts
+        # (the tail-at-scale hole one layer above the fan-out itself).
+        # task() re-installs the request's trace context so the poll's
+        # outbound hop still carries the trace header.
+        if peers:
+            timeout = self._probe_timeout()
+            ctx = current_ctx()
+            if ctx is not None:
+                rem = ctx.remaining()
+                if rem is not None:
+                    timeout = max(min(timeout, rem + 0.05), 0.05)
             try:
-                got = self.client.available_shards(n.host, index)
-            except Exception as e:
-                if on_error is not None:
-                    on_error(n.id, e)
-                if mark_down:
-                    self._mark_down(n.id)
-                continue
-            with self._shards_lock:
-                self._remote_shards.setdefault(index, set()).update(got)
+                futs = [(n, self._pool.submit(
+                    GLOBAL_TRACER.task(self.client.available_shards),
+                    n.host, index, timeout)) for n in peers]
+            except RuntimeError:
+                futs = []  # pool shut down: close() raced this query
+            # Straggler grace: wait up to the hedge delay, then stop
+            # BLOCKING on slow polls — the remembered map serves the
+            # query (exactly the long-standing poll-FAILURE semantic,
+            # reached in bounded time), and the abandoned poll still
+            # completes in the background, folding its answer into the
+            # map for the next query.  Writes this coordinator acked
+            # are never at risk: forwarding already recorded their
+            # shards in the per-field remote sets at ack time.  With
+            # hedging off (or a cold EWMA), polls stay fully patient.
+            grace = self.router.hedge_delay(
+                max(self.hedge_delay_ms, 0.0) / 1e3) \
+                if not patient and self.hedge_reads and futs else None
+            pending = {fut: n for n, fut in futs}
+            if pending:
+                done, _slow = futures_wait(set(pending), timeout=grace)
+                for fut in list(pending):
+                    if fut in done:
+                        self._fold_poll(index, pending.pop(fut), fut,
+                                        mark_down, on_error)
+                for fut, n in pending.items():
+                    fut.add_done_callback(
+                        self._poll_finalizer(index, n, mark_down,
+                                             on_error))
         # include every shard ever reported by a peer: a DOWN owner's
         # shards must stay in the query's scope so the fan-out surfaces
         # the failure instead of silently returning partial results
         with self._shards_lock:
             shards |= self._remote_shards.get(index, set())
         return sorted(shards)
+
+    def _fold_poll(self, index: str, n: Node, fut, mark_down: bool,
+                   on_error):
+        """Fold one completed available-shards poll into the remembered
+        map + the prober's miss accounting (shared by the in-grace and
+        background-completion paths)."""
+        try:
+            got = fut.result()
+        except Exception as e:
+            if on_error is not None:
+                on_error(n.id, e)
+            if mark_down:
+                self._note_probe_failure(n, e)
+                self._update_state()
+            return
+        if n.state == NODE_READY:
+            n.probe_fails = 0
+        with self._shards_lock:
+            self._remote_shards.setdefault(index, set()).update(got)
+
+    def _poll_finalizer(self, index: str, n: Node, mark_down: bool,
+                        on_error):
+        """Done-callback for a poll its query stopped waiting on (the
+        straggler grace elapsed): the late answer still converges the
+        remembered map, and a real failure still counts its miss."""
+        def _done(fut):
+            self._fold_poll(index, n, fut, mark_down, on_error)
+        return _done
 
     # -- query fan-out (executor.go:2455 mapReduce) ------------------------
 
@@ -1510,13 +1602,16 @@ class Cluster:
         if translator.needs_translation(index):
             results = translator.translate_results(index, query.calls,
                                                    results)
-        if qkey is not None:
+        if qkey is not None and not degraded.is_partial():
             # Fill key = lookup-time local state + the peer gen summaries
             # AS OBSERVED by this fan-out's responses.  Only the seen
             # vector is re-read: the responses describe exactly the data
             # the results came from (so the first warm repeat hits),
             # while everything captured at lookup time guarantees a
             # concurrent write's invalidation can never be overwritten.
+            # A PARTIAL answer (shards lost under partialResults) is
+            # never cached: a later healthy repeat must recompute, not
+            # serve the degraded result.
             cache.fill(qkey, qkey + local_part +
                        (self._peer_seen_vector(index),), results)
         return results
@@ -1596,11 +1691,28 @@ class Cluster:
 
     def _fan_out_multi(self, index: str, calls: list[Call],
                        shards: list[int]) -> list[list[Any]]:
-        """Fan one pinned multi-call query to shard owners with replica
-        retry; returns per-call lists of group results.  Per-node wire
-        overhead (POST elapsed minus the peer's reported execution time)
-        and peer execution time feed /debug/vars for the distributed
-        latency breakdown."""
+        """Fan one pinned multi-call query to shard owners, tail-
+        tolerantly (docs/robustness.md "Tail-tolerant fan-out"); returns
+        per-call lists of group results.
+
+        Responses are consumed AS THEY COMPLETE: a failed owner's shards
+        re-dispatch to a replica immediately, while other peers are
+        still in flight, instead of after the whole wave drains.  A
+        straggling-but-alive peer gets a HEDGE — after its hedge delay
+        (hedge-delay-ms, or EWMA-derived; parallel/routing.py) the same
+        call set speculatively duplicates to the next-best replica and
+        the first answer wins, the loser is ignored.  Safe because every
+        call through this path is an idempotent internal read — writes
+        fan out through their own replica-synchronous paths and are
+        never hedged.  Shards whose every replica is exhausted either
+        fail the query loudly (with a per-node attempt log on the error
+        and a ``cluster.fanout_failed`` event) or, when the request
+        opted into partial results (utils/degraded.py), degrade to a
+        partial answer that names exactly the missing shards.
+
+        Per-node wire overhead (POST elapsed minus the peer's reported
+        execution time) and peer execution time feed /debug/vars for the
+        distributed latency breakdown."""
         stats = self.api.stats
         out: list[list[Any]] = [[] for _ in calls]
         q = Query(list(calls))
@@ -1609,18 +1721,130 @@ class Cluster:
                     index, q, [], translate=False)):
                 out[i].append(r)
             return out
-        exclude: set[str] = set()
-        pending = list(shards)
-        last_err: Exception | None = None
         ctx = current_ctx()
-        for _attempt in range(len(self.nodes) + 1):
-            if not pending:
-                break
-            if ctx is not None:
-                ctx.check("cluster fan-out")
+        # a shard group may be re-dispatched at most this many times —
+        # the same bound the old whole-wave retry loop enforced
+        max_wave = len(self.nodes) + 1
+        hedge_enabled = self.hedge_reads and len(self.nodes) > 1
+        hedge_fixed_s = max(self.hedge_delay_ms, 0.0) / 1e3
+        exclude: set[str] = set()
+        remaining: set[int] = {int(s) for s in shards}
+        failed_nodes: set[str] = set()
+        attempts: list[dict] = []  # per-node attempt log (error surface)
+        last_err: Exception | None = None
+        partial_counted = False
+        # one in-flight dispatch per future.  First-answer-wins is
+        # per-SHARD-SET with all-or-nothing acceptance: a flight's
+        # results are per-group AGGREGATES (a Count over its whole
+        # shard list) and can never be split, so a completed flight is
+        # accepted only when EVERY one of its shards is still
+        # unanswered; otherwise it is discarded whole and any leftover
+        # shards nothing else covers re-dispatch.  `cover` counts the
+        # in-flight flights per shard so a failure only re-dispatches
+        # shards no surviving twin still covers.
+        inflight: dict[Any, dict] = {}  # future -> flight dict
+        cover: dict[int, int] = {}
+
+        def submit(nid: str, nshards: list[int], wave: int,
+                   hedge: bool = False):
+            for s in nshards:
+                cover[s] = cover.get(s, 0) + 1
+            # remotes inherit the coordinator's REMAINING budget (wire
+            # header + clamped socket timeout), recomputed per dispatch
+            # so retries and hedges inherit the shrunken budget
+            deadline_s = ctx.remaining() if ctx is not None else None
+            # deadline rides as an extra arg ONLY when a budget is set,
+            # so the un-budgeted call convention stays stable
+            args = (self.by_id[nid].host, index, calls, list(nshards))
+            if deadline_s is not None:
+                args += (deadline_s,)
+            # router feed: coordinator-observed in-flight depth and the
+            # per-shard load counters the balancer watches
+            self.router.note_dispatch(nid, len(nshards))
+            self.load_tracker.note(index, nshards, nid)
+
+            # the router's RTT sample is timed INSIDE the pool worker:
+            # the consumption loop's elapsed also counts local execution
+            # and other peers' result waits, which would systematically
+            # inflate remote scores vs local
+            def timed_rpc(*a, _fn=self.client.query_calls):
+                t = time.perf_counter()
+                return _fn(*a), time.perf_counter() - t
+
+            hedge_at = None
+            if hedge_enabled and not hedge:
+                d = self.router.hedge_delay(hedge_fixed_s)
+                if d is not None:
+                    hedge_at = time.perf_counter() + d
+            span_tags = {"host": self.by_id[nid].host}
+            if hedge:
+                span_tags["hedge"] = True
+            # task(): the pool worker re-installs this thread's trace
+            # context and runs the RPC under a per-peer client span —
+            # the injected header then carries that span's id, so the
+            # remote's spans parent under it (docs/observability.md)
+            fut = self._pool.submit(
+                GLOBAL_TRACER.task(timed_rpc,
+                                   name=f"cluster.rpc {nid}",
+                                   **span_tags),
+                *args)
+            inflight[fut] = {"nid": nid,
+                             "shards": tuple(int(s) for s in nshards),
+                             "wave": wave, "hedge": hedge,
+                             "hedged": False,
+                             "t0": time.perf_counter(),
+                             "hedge_at": hedge_at}
+
+        def run_local(nshards: list[int], wave: int):
+            self.router.note_dispatch(self.node_id, len(nshards))
+            self.load_tracker.note(index, nshards, self.node_id)
+            t_local = time.perf_counter()
             try:
-                groups = self._group_shards(index, pending, exclude)
-            except ClusterError:
+                with stats.timer("cluster.multi.local_exec"), \
+                        qprof.stage("local_exec"):
+                    for i, r in enumerate(self.api.executor.execute(
+                            index, q, list(nshards), translate=False)):
+                        out[i].append(r)
+            finally:
+                self.router.note_done(
+                    self.node_id, time.perf_counter() - t_local)
+            remaining.difference_update(int(s) for s in nshards)
+
+        def unservable(shard_set: set[int], exhausted: bool):
+            """Every replica of these shards is gone: degrade to a
+            partial answer when the request opted in, else raise with
+            the per-node attempt log attached."""
+            nonlocal partial_counted
+            if ctx is not None:
+                ctx.check("cluster fan-out")  # expired -> 504, not 500
+            if degraded.partial_allowed():
+                degraded.note_missing(index, shard_set, failed_nodes)
+                if not partial_counted:
+                    stats.count("cluster.partial_results")
+                    partial_counted = True
+                self._fanout_event(index, shard_set, attempts,
+                                   partial=True)
+                remaining.difference_update(shard_set)
+                return
+            self._fanout_event(index, shard_set, attempts, partial=False)
+            base = "query retries exhausted" if exhausted else \
+                (f"no replicas available for shards "
+                 f"{sorted(shard_set)} of {index!r}")
+            err = ClusterError(base + self._attempts_suffix(attempts))
+            err.attempts = list(attempts)
+            raise err from last_err
+
+        def dispatch_shards(shard_set: set[int], wave: int):
+            if wave >= max_wave:
+                unservable(shard_set, exhausted=True)
+                return
+            if wave > 0:
+                stats.count("cluster.retry_waves")
+            nonlocal last_err
+            try:
+                groups = self._group_shards(index, sorted(shard_set),
+                                            exclude)
+            except ClusterError as e:
                 # re-admit owners that failed with an APPLICATION error
                 # (they responded — still READY): one failure is not
                 # death, so they get another pass.  Transport-failed
@@ -1630,125 +1854,260 @@ class Cluster:
                 readmit = {nid for nid in exclude
                            if self.by_id[nid].state == NODE_READY}
                 if not readmit:
-                    raise
-                exclude -= readmit
-                groups = self._group_shards(index, pending, exclude)
-            futures = {}
+                    last_err = e
+                    unservable(shard_set, exhausted=False)
+                    return
+                exclude.difference_update(readmit)
+                try:
+                    groups = self._group_shards(index, sorted(shard_set),
+                                                exclude)
+                except ClusterError as e2:
+                    last_err = e2
+                    unservable(shard_set, exhausted=False)
+                    return
             local_shards = groups.pop(self.node_id, None)
-            # remotes inherit the coordinator's REMAINING budget (wire
-            # header + clamped socket timeout), computed per wave so
-            # retries shrink it further
-            deadline_s = ctx.remaining() if ctx is not None else None
             for nid, nshards in groups.items():
-                # deadline rides as an extra arg ONLY when a budget is
-                # set, so the un-budgeted call convention stays stable
-                args = (self.by_id[nid].host, index, calls, nshards)
-                if deadline_s is not None:
-                    args += (deadline_s,)
-                # router feed: coordinator-observed in-flight depth and
-                # the per-shard load counters the balancer watches
-                self.router.note_dispatch(nid, len(nshards))
-                self.load_tracker.note(index, nshards, nid)
-
-                # the router's RTT sample is timed INSIDE the pool
-                # worker: the collection-loop elapsed below also counts
-                # local execution and earlier peers' result waits, which
-                # would systematically inflate remote scores vs local
-                def timed_rpc(*a, _fn=self.client.query_calls):
-                    t = time.perf_counter()
-                    return _fn(*a), time.perf_counter() - t
-
-                # task(): the pool worker re-installs this thread's trace
-                # context and runs the RPC under a per-peer client span —
-                # the injected header then carries that span's id, so the
-                # remote's spans parent under it (docs/observability.md)
-                futures[nid] = (nshards, time.perf_counter(),
-                                self._pool.submit(
-                                    GLOBAL_TRACER.task(
-                                        timed_rpc,
-                                        name=f"cluster.rpc {nid}",
-                                        host=self.by_id[nid].host),
-                                    *args))
+                submit(nid, nshards, wave)
             if local_shards is not None:
-                self.router.note_dispatch(self.node_id, len(local_shards))
-                self.load_tracker.note(index, local_shards, self.node_id)
-                t_local = time.perf_counter()
-                try:
-                    with stats.timer("cluster.multi.local_exec"), \
-                            qprof.stage("local_exec"):
-                        for i, r in enumerate(self.api.executor.execute(
-                                index, q, local_shards, translate=False)):
-                            out[i].append(r)
-                finally:
-                    self.router.note_done(
-                        self.node_id, time.perf_counter() - t_local)
-            pending = []
-            for nid, (nshards, t0, fut) in futures.items():
-                try:
-                    (res, exec_s, peer_gens, peer_quarantined,
-                     peer_load), rtt = fut.result()
-                    self.router.note_done(nid, rtt)
-                    self.router.note_query_load(nid, peer_load)
-                    if peer_quarantined:
-                        # peer answered with quarantined fragments serving
-                        # empty: surface it on THIS response (consumed on
-                        # the request thread, where the handler's
-                        # degraded collector is active)
-                        degraded.note(peer_quarantined)
-                    elapsed = time.perf_counter() - t0
-                    stats.timing("cluster.multi.peer_exec", exec_s)
-                    stats.timing("cluster.multi.wire_overhead",
-                                 max(elapsed - exec_s, 0.0))
-                    # per-peer fan-out RTT in the profile tree: total
-                    # round trip, the peer's own execution time, and the
-                    # wire/serialization overhead between them
-                    qprof.event(f"peer.{nid}", elapsed,
-                                shards=len(nshards),
-                                peerExecS=round(exec_s, 6),
-                                wireS=round(max(elapsed - exec_s, 0.0),
-                                            6))
-                    self.note_peer_gens(index, nid, peer_gens)
-                    for i, r in enumerate(res):
-                        out[i].append(r)
-                except CircuitOpenError as e:
-                    # fail-fast: the peer's breaker is open (N consecutive
-                    # transport failures) — treat like a dead node, not an
-                    # application error from a live one.  (The router
-                    # pre-skips open breakers, so this only fires when
-                    # EVERY candidate was open or the breaker opened
-                    # mid-flight.)
-                    last_err = e
-                    self.router.note_done(nid, None, ok=False)
-                    self._mark_down(nid)
-                    exclude.add(nid)
-                    pending.extend(nshards)
-                except ClusterError as e:
-                    # the peer RESPONDED (HTTP error): it is alive, so an
-                    # application-level failure must not poison
-                    # membership — just retry these shards on a replica
-                    last_err = e
-                    self.router.note_done(nid, None, ok=False)
-                    exclude.add(nid)
-                    pending.extend(nshards)
-                except Exception as e:
-                    last_err = e
-                    self.router.note_done(nid, None, ok=False)
-                    self._mark_down(nid)
-                    exclude.add(nid)
-                    pending.extend(nshards)
-            if not pending:
-                break
-        else:
-            if ctx is not None:
-                ctx.check("cluster fan-out")  # expired -> 504, not 500
-            raise ClusterError("query retries exhausted") from last_err
-        if pending:
-            if ctx is not None:
-                ctx.check("cluster fan-out")  # expired -> 504, not 500
-            raise ClusterError(
-                f"no replicas available for shards {pending} of "
-                f"{index!r}") from last_err
+                run_local(local_shards, wave)
+
+        def record_failure(fl: dict, e: Exception, down: bool):
+            nonlocal last_err
+            last_err = e
+            attempts.append({"node": fl["nid"], "wave": fl["wave"],
+                             "hedge": fl["hedge"],
+                             "shards": len(fl["shards"]),
+                             "error": f"{type(e).__name__}: {e}"})
+            failed_nodes.add(fl["nid"])
+            self.router.note_done(fl["nid"], None, ok=False)
+            if down:
+                self._mark_down(fl["nid"])
+            exclude.add(fl["nid"])
+            # re-dispatch only the shards no surviving twin (hedge or
+            # primary) still covers — a still-flying duplicate gets to
+            # answer before another retry burns a wave
+            retry = {s for s in fl["shards"]
+                     if s in remaining and cover.get(s, 0) == 0}
+            if retry:
+                dispatch_shards(retry, fl["wave"] + 1)
+
+        def accept(fl: dict, res, exec_s, peer_gens, peer_quarantined,
+                   peer_load, rtt):
+            self.router.note_done(fl["nid"], rtt)
+            self.router.note_query_load(fl["nid"], peer_load)
+            unanswered = [s for s in fl["shards"] if s in remaining]
+            if len(unanswered) != len(fl["shards"]):
+                # a racing flight (hedge winner / replica retry) already
+                # answered part of this group.  The group's results are
+                # aggregates over its WHOLE shard list — they cannot be
+                # split — so discard them entirely, and re-dispatch any
+                # leftover shards nothing else still covers (rare: only
+                # a lost race can produce leftovers, so progress was
+                # made elsewhere and this terminates)
+                leftover = {s for s in unanswered
+                            if cover.get(s, 0) == 0}
+                if leftover:
+                    dispatch_shards(leftover, fl["wave"])
+                return
+            if fl["hedge"]:
+                stats.count("cluster.hedge_wins")
+                self.router.note_hedge_win(fl["nid"])
+            if peer_quarantined:
+                # peer answered with quarantined fragments serving
+                # empty: surface it on THIS response (consumed on the
+                # request thread, where the handler's degraded
+                # collector is active)
+                degraded.note(peer_quarantined)
+            elapsed = time.perf_counter() - fl["t0"]
+            stats.timing("cluster.multi.peer_exec", exec_s)
+            stats.timing("cluster.multi.wire_overhead",
+                         max(elapsed - exec_s, 0.0))
+            # per-peer fan-out RTT in the profile tree: total round
+            # trip, the peer's own execution time, and the wire/
+            # serialization overhead between them
+            qprof.event(f"peer.{fl['nid']}", elapsed,
+                        shards=len(fl["shards"]),
+                        peerExecS=round(exec_s, 6),
+                        wireS=round(max(elapsed - exec_s, 0.0), 6))
+            self.note_peer_gens(index, fl["nid"], peer_gens)
+            for i, r in enumerate(res):
+                out[i].append(r)
+            remaining.difference_update(fl["shards"])
+
+        try:
+            # the initial dispatch runs INSIDE the finalizer scope: if
+            # local execution (or a mid-submit pool shutdown) raises
+            # while remote RPCs are already flying, their router
+            # in-flight depth must still unwind via the done-callbacks
+            dispatch_shards(remaining.copy(), 0)
+            # run until every shard is answered or abandoned — NOT until
+            # every future drains: once a hedge (or a replica retry) has
+            # answered a group, its loser must not hold the query open
+            while remaining:
+                if not inflight:
+                    # unanswered shards with nothing flying: fail or
+                    # degrade (clears `remaining` either way)
+                    unservable(remaining.copy(), exhausted=True)
+                    continue
+                if ctx is not None:
+                    ctx.check("cluster fan-out")
+                # wake for whichever comes first: a completion, the
+                # next hedge deadline, or the query deadline
+                timeout = None
+                if hedge_enabled:
+                    now = time.perf_counter()
+                    due = [fl["hedge_at"] - now
+                           for fl in inflight.values()
+                           if fl["hedge_at"] is not None
+                           and not fl["hedge"] and not fl["hedged"]]
+                    if due:
+                        timeout = max(0.0, min(due))
+                if ctx is not None:
+                    rem = ctx.remaining()
+                    if rem is not None:
+                        rem = max(rem, 0.001)
+                        timeout = rem if timeout is None \
+                            else min(timeout, rem)
+                done, _still = futures_wait(set(inflight),
+                                            timeout=timeout,
+                                            return_when=FIRST_COMPLETED)
+                for fut in done:
+                    fl = inflight.pop(fut)
+                    for s in fl["shards"]:
+                        cover[s] = cover.get(s, 1) - 1
+                    try:
+                        ((res, exec_s, peer_gens, peer_quarantined,
+                          peer_load), rtt) = fut.result()
+                    except CircuitOpenError as e:
+                        # fail-fast: the peer's breaker is open (N
+                        # consecutive transport failures) — treat like
+                        # a dead node, not an application error from a
+                        # live one.  (The router pre-skips open
+                        # breakers, so this only fires when EVERY
+                        # candidate was open or the breaker opened
+                        # mid-flight.)
+                        record_failure(fl, e, down=True)
+                    except ClusterError as e:
+                        # the peer RESPONDED (HTTP error): it is alive,
+                        # so an application-level failure must not
+                        # poison membership — just retry these shards
+                        # on a replica
+                        record_failure(fl, e, down=False)
+                    except Exception as e:
+                        record_failure(fl, e, down=True)
+                    else:
+                        accept(fl, res, exec_s, peer_gens,
+                               peer_quarantined, peer_load, rtt)
+                if hedge_enabled and remaining and inflight:
+                    now = time.perf_counter()
+                    for fl in list(inflight.values()):
+                        if (fl["hedge"] or fl["hedged"]
+                                or fl["hedge_at"] is None
+                                or now < fl["hedge_at"]):
+                            continue
+                        fl["hedged"] = True  # at most one hedge round
+                        hedge_shards = [s for s in fl["shards"]
+                                        if s in remaining]
+                        if not hedge_shards:
+                            continue
+                        excl = exclude | {fl["nid"]}
+                        # cheapest shape first: ONE replica owning the
+                        # whole group duplicates it in a single RPC;
+                        # otherwise split by the router's own grouping
+                        # so every shard still gets a speculative
+                        # second chance (jump-hash rarely gives a big
+                        # group one common alternate owner)
+                        target = self.router.hedge_candidate(
+                            index, hedge_shards, excl)
+                        if target is not None:
+                            groups = {target: list(hedge_shards)}
+                        else:
+                            try:
+                                groups = self._group_shards(
+                                    index, sorted(hedge_shards), excl)
+                            except ClusterError:
+                                continue  # nobody can hedge this group
+                            # hedges go to REMOTE replicas only: local
+                            # execution is not a network-straggler
+                            # path, and running it inline here would
+                            # stall consumption of completed responses
+                            groups.pop(self.node_id, None)
+                        for nid, nshards in groups.items():
+                            stats.count("cluster.hedges")
+                            self.router.note_hedge(nid)
+                            submit(nid, nshards, fl["wave"],
+                                   hedge=True)
+        finally:
+            # abandoned flights (hedge-race losers, RPCs still flying
+            # when the query finished/raised/expired): finalize their
+            # router bookkeeping off-thread — the in-flight depth must
+            # unwind, and a straggler's TRUE RTT still feeds its EWMA
+            # (how the router learns the peer is slow)
+            for fut, fl in list(inflight.items()):
+                fut.add_done_callback(self._flight_finalizer(fl))
         return out
+
+    def _flight_finalizer(self, fl: dict):
+        """Done-callback for a fan-out flight its query abandoned (a
+        hedge race loser, or any RPC still in flight when the query
+        completed, raised, or hit its deadline).  Runs on the pool
+        worker: only router bookkeeping — never the query's own state,
+        which may already be serialized and gone."""
+        def _done(fut):
+            try:
+                ((_res, _exec_s, _gens, _quar, load),
+                 rtt) = fut.result()
+            except Exception:
+                # the query already finished without this flight; the
+                # router's error counter (note_done ok=False) is the
+                # only consumer of the outcome
+                self.router.note_done(fl["nid"], None, ok=False)
+            else:
+                self.router.note_done(fl["nid"], rtt)
+                self.router.note_query_load(fl["nid"], load)
+        return _done
+
+    @staticmethod
+    def _format_attempt(a: dict) -> str:
+        """One attempt-log entry as 'node waveN [hedge]: error' — the
+        shared format of the error suffix and the structured event."""
+        return (f"{a['node']} wave{a['wave']}"
+                + (" hedge" if a["hedge"] else "")
+                + f": {a['error']}")
+
+    @staticmethod
+    def _attempts_suffix(attempts: list[dict]) -> str:
+        """Human-readable per-node attempt trail for fan-out errors —
+        'which node failed how, in which wave' used to be discarded."""
+        if not attempts:
+            return ""
+        tail = attempts[-8:]
+        parts = [Cluster._format_attempt(a) for a in tail]
+        more = f" (+{len(attempts) - len(tail)} earlier)" \
+            if len(attempts) > len(tail) else ""
+        return " [attempts: " + "; ".join(parts) + more + "]"
+
+    def _fanout_event(self, index: str, shard_set, attempts: list[dict],
+                      partial: bool):
+        """Structured ``cluster.fanout_failed`` event: the per-node
+        failure detail that used to vanish into a bare ClusterError."""
+        if self.stats is not None:
+            self.stats.count("cluster.fanout_failed")
+        logger = self.logger
+        if logger is None:
+            return
+        try:
+            logger.event(
+                "cluster.fanout_failed", index=index,
+                shards=sorted(int(s) for s in shard_set)[:64],
+                partial=partial,
+                attempts="; ".join(
+                    self._format_attempt(a) for a in attempts[-8:]))
+        # lint: allow(swallowed-exception) — telemetry must never fail
+        # the query path (the PR 8 retrace-sink lesson); the error
+        # itself still raises/degrades through the caller
+        except Exception:
+            pass
 
     def _execute_call(self, index: str, c: Call, shards: list[int]):
         if c.name in ("Set", "Clear"):
@@ -2273,7 +2632,7 @@ class Cluster:
             holder = self.holder
             for index_name, idx in list(holder.indexes.items()):
                 shards = self._available_shards(
-                    index_name,
+                    index_name, patient=True,
                     on_error=lambda nid, e, i=index_name: self._note_ae_error(
                         f"shard poll for {i} from {nid}", e))
                 for fname, f in list(idx.fields.items()):
@@ -2756,7 +3115,7 @@ class Cluster:
             removed_ids = {n.id for n in removed}
             lost: dict[str, set[int]] = {}
             for index_name in list(self.holder.indexes):
-                for s in self._available_shards(index_name):
+                for s in self._available_shards(index_name, patient=True):
                     old_owners = old_placement.shard_nodes(index_name, s)
                     ready_sources = [
                         o for o in old_owners

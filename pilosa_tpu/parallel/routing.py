@@ -65,6 +65,17 @@ LOCAL_BIAS = 0.8
 
 POLICIES = ("primary", "round-robin", "loaded")
 
+# Hedged reads (docs/robustness.md "Tail-tolerant fan-out"): with
+# hedge-delay-ms = 0 (auto) the hedge fires at this multiple of the
+# CLUSTER's cheapest known EWMA RTT — "how long should this RPC take if
+# a healthy replica served it", the Dean & Barroso quantile idea on the
+# signal the router already keeps.  Deliberately NOT the dispatched
+# peer's own EWMA: a persistently straggling peer would inflate its own
+# hedge delay until hedging never fires, exactly when it matters most.
+HEDGE_EWMA_MULT = 4.0
+# Floor so micro-RTT local clusters don't turn every read into two.
+HEDGE_MIN_DELAY_S = 0.01
+
 
 def tier_fraction(tiers: dict | None, shard: int) -> float:
     """Residency fraction for scoring — the ONE tier mapping (1.0
@@ -86,7 +97,7 @@ class PeerLoad:
 
     __slots__ = ("ewma_rtt_s", "last_rtt_s", "inflight", "reported_inflight",
                  "reported_queued", "residency", "residency_ts",
-                 "dispatches", "errors")
+                 "dispatches", "errors", "hedges", "hedge_wins")
 
     def __init__(self):
         self.ewma_rtt_s: float | None = None
@@ -99,6 +110,11 @@ class PeerLoad:
         self.residency_ts: float | None = None  # monotonic, for staleness
         self.dispatches = 0
         self.errors = 0
+        # hedged reads: speculative duplicates dispatched TO this peer,
+        # and how many of those answered first (per-peer hedge state for
+        # /debug/vars cluster.routing)
+        self.hedges = 0
+        self.hedge_wins = 0
 
     def note_rtt(self, rtt_s: float):
         self.last_rtt_s = rtt_s
@@ -168,6 +184,18 @@ class ReadRouter:
                 p.note_rtt(rtt_s)
             elif not ok:
                 p.errors += 1
+
+    def note_hedge(self, nid: str):
+        """A speculative duplicate was dispatched to ``nid``."""
+        p = self._peer(nid)
+        with self._lock:
+            p.hedges += 1
+
+    def note_hedge_win(self, nid: str):
+        """``nid``'s hedged answer arrived before the original's."""
+        p = self._peer(nid)
+        with self._lock:
+            p.hedge_wins += 1
 
     def note_query_load(self, nid: str, load: dict | None):
         """Admission depth piggybacked on an /internal/query response."""
@@ -328,6 +356,64 @@ class ReadRouter:
         # (Cluster.residency_summary caches for 2s) — no staleness gate
         return tier_fraction((local_res or {}).get(index), shard)
 
+    # -- hedged reads (docs/robustness.md "Tail-tolerant fan-out") ---------
+
+    def hedge_delay(self, fixed_s: float = 0.0) -> float | None:
+        """Seconds an in-flight read RPC may run before a speculative
+        duplicate fires.  ``fixed_s > 0`` (hedge-delay-ms) wins; auto
+        mode derives HEDGE_EWMA_MULT x the cheapest KNOWN peer EWMA (see
+        the constant's comment for why not the dispatched peer's own).
+        None = no history yet — a cold cluster must not hedge blind."""
+        if fixed_s > 0:
+            return fixed_s
+        with self._lock:
+            known = [p.ewma_rtt_s for p in self._peers.values()
+                     if p.ewma_rtt_s is not None]
+        if not known:
+            return None
+        return max(HEDGE_MIN_DELAY_S, HEDGE_EWMA_MULT * min(known))
+
+    def hedge_candidate(self, index: str, shards,
+                        exclude=frozenset()) -> str | None:
+        """Best replica to receive a speculative duplicate of a whole
+        dispatched shard group: must be READY, own EVERY shard of the
+        group (a partial hedge could double-count shards against the
+        original's aggregate answer), not excluded, not breaker-open,
+        and not the local node (local execution is not a network
+        straggler).  Cheapest load score wins; None = nobody qualifies
+        and the group goes unhedged."""
+        cluster = self.cluster
+        cand: set[str] | None = None
+        for s in shards:
+            owners = {o for o in cluster._ready_owner_order(index, s)
+                      if cluster.by_id[o].state == "READY"}
+            cand = owners if cand is None else cand & owners
+            if not cand:
+                return None
+        cand -= set(exclude)
+        cand.discard(cluster.node_id)
+        cand = {nid for nid in cand
+                if not cluster.client.breaker_open(
+                    cluster.by_id[nid].host)}
+        if not cand:
+            return None
+        # same optimistic default as _pick_loaded: a no-history
+        # candidate scores with the cheapest KNOWN candidate's EWMA so
+        # it stays explorable WITHOUT unconditionally beating a known-
+        # fast idle replica (and its queue pressure still counts —
+        # hedges fire exactly when latency matters most).  All-unknown
+        # degenerates to pure pressure ordering.
+        infos = [(nid,) + self._load_factors(nid) for nid in sorted(cand)]
+        known = [ewma for _, ewma, _ in infos if ewma is not None]
+        default_ewma = min(known) if known else 1.0
+        best = None
+        best_score = None
+        for nid, ewma, pressure in infos:
+            score = (ewma if ewma is not None else default_ewma) * pressure
+            if best_score is None or score < best_score:
+                best, best_score = nid, score
+        return best
+
     # -- observability -----------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -352,6 +438,8 @@ class ReadRouter:
                         for iname, t in p.residency.items()},
                     "dispatches": p.dispatches,
                     "errors": p.errors,
+                    "hedges": p.hedges,
+                    "hedgeWins": p.hedge_wins,
                 }
             out = {
                 "policy": self.policy,

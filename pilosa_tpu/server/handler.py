@@ -181,17 +181,28 @@ def build_router(api: API, server=None) -> Router:
         shards = None
         if "shards" in req.query:
             shards = [int(s) for s in req.query["shards"][0].split(",")]
+        # Partial-results opt-in (docs/robustness.md "Partial
+        # results"): ?partialResults=true (or the partial-results
+        # server default) lets a READ succeed when shards are truly
+        # unservable — the degraded object below then names exactly the
+        # missing shards, so partial can never masquerade as complete.
+        # the per-request parameter wins in BOTH directions: an
+        # explicit ?partialResults=false demands the loud failure even
+        # on a partial-results=true deployment
+        pq = req.query.get("partialResults", [None])[0]
+        partial = (pq == "true") if pq is not None else req.partial_results
         # Degraded-state collection (utils/degraded.py): quarantined
         # fragments answer as EMPTY — the response must say so.  The
         # coordinator notes peer-reported counts during fan-out; the
         # local holder's count is added here.
-        with degraded.collect() as deg:
+        with degraded.collect(allow_partial=partial) as deg:
             results = api.query(args["index"], query, shards)
             degraded.note(
                 len(api.holder.quarantined_fragments(args["index"])))
         out = {"results": [serialize_result(x) for x in results]}
-        if deg["quarantinedFragments"]:
-            out["degraded"] = dict(deg)
+        deg_out = degraded.to_response(deg)
+        if deg_out is not None:
+            out["degraded"] = deg_out
         # top-level ColumnAttrSets, deduplicated by column id across the
         # query's calls like the reference's single set
         # (http/response.go QueryResponse)
@@ -763,6 +774,11 @@ class _HandlerClass(BaseHTTPRequestHandler):
     admission_ingest = None
     ingest_max_frame_bytes: int = 32 << 20
     default_query_timeout: float = 0.0
+    # Partial-results server default (docs/robustness.md "Partial
+    # results"): when true, every public query behaves as if it carried
+    # ?partialResults=true.  Off by default — losing shards should fail
+    # loudly unless the deployment explicitly prefers availability.
+    partial_results: bool = False
     stats = None
     # Observability (docs/observability.md).  slowlog: SlowQueryLog ring
     # capturing queries past slow-query-threshold (None = off).
@@ -1106,6 +1122,7 @@ def make_http_server(api: API, host: str = "localhost", port: int = 10101,
                      admission_ingest=None,
                      ingest_max_frame_bytes: int | None = None,
                      default_query_timeout: float | None = None,
+                     partial_results: bool | None = None,
                      slowlog=None, profile_default: bool | None = None,
                      ) -> ThreadingHTTPServer:
     """``tls``: optional (certificate, key, ca_certificate|None) paths —
@@ -1131,6 +1148,8 @@ def make_http_server(api: API, host: str = "localhost", port: int = 10101,
         attrs["ingest_max_frame_bytes"] = ingest_max_frame_bytes
     if default_query_timeout is not None:
         attrs["default_query_timeout"] = default_query_timeout
+    if partial_results is not None:
+        attrs["partial_results"] = partial_results
     if slowlog is not None:
         attrs["slowlog"] = slowlog
     if profile_default is not None:

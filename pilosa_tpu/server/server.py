@@ -137,6 +137,23 @@ class Config:
     # Consecutive SOFT probe failures (timeouts/resets — refused
     # connections flip immediately) before NODE_DOWN.
     health_down_threshold: int = 2
+    # -- tail-tolerant reads (docs/robustness.md "Tail-tolerant fan-out")
+    # Hedged reads: a read fan-out RPC still unanswered after its hedge
+    # delay speculatively duplicates to the next-best replica; the first
+    # answer wins, the loser is ignored.  Internal read calls are
+    # idempotent, so hedging never changes answers; writes are never
+    # hedged.  Off disables speculation entirely.
+    hedge_reads: bool = True
+    # Milliseconds before an in-flight read RPC is hedged.  0 (default)
+    # derives the delay per dispatch from the router's EWMA RTT (a
+    # multiple of the cheapest known peer RTT — see parallel/routing.py);
+    # a cold cluster with no RTT history then hedges nothing.
+    hedge_delay_ms: float = 0.0
+    # Server default for ?partialResults: when true, a read whose shards
+    # are truly unservable (every replica dead/partitioned/exhausted)
+    # answers with what it has, and the response's degraded object names
+    # exactly the missing shards/nodes.  Off = such reads fail loudly.
+    partial_results: bool = False
     # -- elastic serving (docs/cluster.md "Read routing & rebalancing") ----
     # Read fan-out replica policy: "primary" pins reads to the jump-hash
     # primary (the pre-routing behavior, byte-for-byte), "round-robin"
@@ -279,6 +296,11 @@ class Config:
             "PILOSA_TPU_DRAIN_SECONDS": ("drain_seconds", float),
             "PILOSA_TPU_HEALTH_DOWN_THRESHOLD": ("health_down_threshold",
                                                  int),
+            "PILOSA_TPU_HEDGE_READS": (
+                "hedge_reads", lambda s: s != "false"),
+            "PILOSA_TPU_HEDGE_DELAY_MS": ("hedge_delay_ms", float),
+            "PILOSA_TPU_PARTIAL_RESULTS": (
+                "partial_results", lambda s: s == "true"),
             "PILOSA_TPU_READ_ROUTING": ("read_routing", str),
             "PILOSA_TPU_RESIDENCY_ROUTING": (
                 "residency_routing", lambda s: s != "false"),
@@ -346,6 +368,9 @@ class Config:
             "breaker-threshold": "breaker_threshold",
             "drain-seconds": "drain_seconds",
             "health-down-threshold": "health_down_threshold",
+            "hedge-reads": "hedge_reads",
+            "hedge-delay-ms": "hedge_delay_ms",
+            "partial-results": "partial_results",
             "read-routing": "read_routing",
             "residency-routing": "residency_routing",
             "balancer": "balancer",
@@ -454,7 +479,12 @@ class Server:
                 balancer=self.config.balancer,
                 balancer_interval=self.config.balancer_interval,
                 hot_shard_threshold=self.config.hot_shard_threshold,
+                hedge_reads=self.config.hedge_reads,
+                hedge_delay_ms=self.config.hedge_delay_ms,
             )
+            # fan-out failure events (cluster.fanout_failed) land in the
+            # server log like the whole-query fallbacks
+            self.cluster.logger = self.logger
             if not self.cluster.is_coordinator:
                 # key translation lives on the coordinator; replicas route
                 # to it with a read-through cache
@@ -550,6 +580,7 @@ class Server:
             ingest_max_frame_bytes=max(
                 self.config.ingest_max_frame_mb, 1) << 20,
             default_query_timeout=self.config.query_timeout,
+            partial_results=self.config.partial_results,
             slowlog=self.slowlog,
             profile_default=self.config.profile_default)
         from ..utils.diagnostics import DiagnosticsCollector

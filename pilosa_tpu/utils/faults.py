@@ -45,6 +45,34 @@ class FaultInjected(OSError):
     treats it exactly like the real fault it simulates."""
 
 
+def parse_spec(spec: str) -> list[tuple[str, str, float, str | None,
+                                        int | None]]:
+    """Parse a ``name=mode[:arg][@match][#times];...`` spec into
+    ``(name, mode, arg, match, times)`` tuples.  Shared grammar between
+    the in-process failpoint registry (this module) and the network
+    fault layer (utils/netchaos.py ChaosProxy) — one spec syntax for
+    every chaos surface; each consumer validates its own mode set."""
+    out = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, rhs = part.partition("=")
+        if not rhs:
+            raise ValueError(f"bad failpoint spec {part!r}")
+        times = None
+        if "#" in rhs:
+            rhs, _, t = rhs.rpartition("#")
+            times = int(t)
+        match = None
+        if "@" in rhs:
+            rhs, _, match = rhs.partition("@")
+        mode, _, arg = rhs.partition(":")
+        out.append((name.strip(), mode.strip(),
+                    float(arg) if arg else 0.0, match or None, times))
+    return out
+
+
 class _Fault:
     __slots__ = ("mode", "arg", "match", "times", "hits")
 
@@ -78,23 +106,8 @@ class FaultRegistry:
 
     def configure(self, spec: str):
         """Parse and arm a ``name=mode[:arg][@match][#times];...`` spec."""
-        for part in (spec or "").split(";"):
-            part = part.strip()
-            if not part:
-                continue
-            name, _, rhs = part.partition("=")
-            if not rhs:
-                raise ValueError(f"bad failpoint spec {part!r}")
-            times = None
-            if "#" in rhs:
-                rhs, _, t = rhs.rpartition("#")
-                times = int(t)
-            match = None
-            if "@" in rhs:
-                rhs, _, match = rhs.partition("@")
-            mode, _, arg = rhs.partition(":")
-            self.arm(name.strip(), mode.strip(),
-                     float(arg) if arg else 0.0, match or None, times)
+        for name, mode, arg, match, times in parse_spec(spec):
+            self.arm(name, mode, arg, match, times)
 
     def hit(self, name: str, key: str = ""):
         """Trigger point.  MUST stay near-free when nothing is armed —

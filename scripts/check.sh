@@ -39,10 +39,17 @@ python -m pilosa_tpu.analysis
 # byte-identity guarantee (a routing bug would serve wrong answers from
 # a stale replica silently), and the balancer handoff test covers the
 # overlay epoch protocol every node's ownership view depends on.
+# The tail-tolerance suite (docs/robustness.md "Tail-tolerant fan-out")
+# joins them: hedged reads and partial results both sit on exactness
+# contracts — hedged answers must be byte-identical to unhedged ones,
+# and degraded.missingShards must name EXACTLY the lost shards — and a
+# bug in either silently corrupts or silently truncates answers.  The
+# fast deterministic subset (real-socket ChaosProxy faults) runs here;
+# the 20-cycle churn soak is pytest -m slow.
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' -p no:cacheprovider \
     tests/test_durability.py tests/test_crash.py tests/test_containers.py \
     tests/test_device_obs.py tests/test_ingest.py tests/test_wholequery.py \
-    tests/test_routing.py
+    tests/test_routing.py tests/test_churn.py
 
 # committed bytecode/cache artifacts must never land in the tree (shell
 # stays the right layer for a git-index check)

@@ -73,6 +73,16 @@ def test_bench_smoke_resident_and_budgeted():
     assert rt["answers_identical"] is True
     assert rt["hot_shard_nodes"] > 1
     assert rt["qps_loaded"] > 0 and rt["qps_primary"] > 0
+    # tail-tolerance leg (docs/robustness.md "Tail-tolerant fan-out"):
+    # under a real-socket ChaosProxy straggler, hedged reads held p99
+    # under the injected delay while the unhedged run was bound by it,
+    # with answers byte-identical across baseline/hedged/unhedged (the
+    # asserts live in bench.py; re-check the published signals)
+    ch = data["chaos"]
+    assert ch["answers_identical"] is True
+    assert ch["hedges"] > 0 and ch["hedge_wins"] > 0
+    assert ch["p99_hedged_ms"] < ch["injected_delay_ms"]
+    assert ch["p99_hedged_ms"] < ch["p99_unhedged_ms"]
     # observability leg (docs/observability.md): profile-off serving
     # stays within 5% of the batching leg (asserted in bench.py) and
     # profile-on returned a populated stage tree + resolvable trace
