@@ -688,9 +688,11 @@ def bench_config5_distributed(rng):
         # replay corpus and restore the threshold before measuring
         for srv in servers:
             srv.slowlog.threshold_s = 1e-9
-        # recorded batches stay under the slow log's QUERY_TEXT_MAX so
-        # the harvested text replays verbatim (longer entries are stored
-        # truncated — the filter below drops any that were)
+        # the slow log marks over-ceiling entries textTruncated
+        # (slow-log-text-max knob): the harvester skips those BY FLAG —
+        # a truncated batch replays as a parse error, and the old
+        # length-heuristic filter silently depended on the exact
+        # ceiling value
         mixed = [_cfg5_batch(rng, 4) for _ in range(12)]
         for i in range(16):
             a = int(rng.integers(0, 4))
@@ -701,14 +703,13 @@ def bench_config5_distributed(rng):
         for i, m in enumerate(mixed):
             post(ports[i % 4], "/index/dist/query", m.encode(),
                  timeout=1800)
-        from pilosa_tpu.utils.slowlog import QUERY_TEXT_MAX
         corpus = []
         for p in ports:
             slow = json.loads(req(p, "GET", "/debug/slow"))
             corpus.extend(
                 e["query"] for e in slow.get("entries", [])
                 if e.get("index") == "dist" and e.get("query")
-                and len(e["query"]) < QUERY_TEXT_MAX)
+                and not e.get("textTruncated"))
         assert len(corpus) >= len(mixed), \
             f"slow-log recorded only {len(corpus)} of {len(mixed)}"
         for srv in servers:

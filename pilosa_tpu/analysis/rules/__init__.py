@@ -3,6 +3,7 @@ astlint registry (one module per rule, docs/static-analysis.md)."""
 
 from . import (  # noqa: F401
     batcher_bypass,
+    event_names,
     except_swallow,
     failpoints,
     metrics_docs,

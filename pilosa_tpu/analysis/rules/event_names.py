@@ -1,0 +1,93 @@
+"""event-names: two-way events.emit <-> docs/observability.md catalog.
+
+The event catalog (the ``events-catalog`` markers) is the operator's
+contract for the /debug/events journal and the /debug/cluster fleet
+timeline, exactly like the metrics catalog is for /metrics: an
+uncataloged ``events.emit("...")`` site produces timeline entries no
+runbook explains, and a dangling catalog row documents an event that
+can never fire (the failpoint-names lesson — a name nothing emits reads
+as "this never happened" when it actually CAN'T happen).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astlint import Finding, project_rule
+
+CATALOG = re.compile(r"<!-- events-catalog:begin -->(.*?)"
+                     r"<!-- events-catalog:end -->", re.S)
+
+
+def _recv(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _emit_sites(mod):
+    """(name, line) for every literal ``events.emit("...")`` /
+    ``EVENTS.emit("...")`` call in a module."""
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "emit"):
+            continue
+        recv = _recv(node.func.value)
+        if not (recv.endswith("events") or recv.endswith("EVENTS")
+                or recv.endswith("self")):
+            continue
+        # self.emit(...) only counts inside utils/events.py itself
+        if recv.endswith("self") and not mod.rel.endswith(
+                "utils/events.py"):
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            yield node.args[0].value, node.lineno
+
+
+@project_rule("event-names")
+def check(modules, root):
+    """events.emit name missing from the catalog / row no site emits."""
+    code: dict[str, tuple[str, int]] = {}
+    for rel, mod in modules.items():
+        if not rel.startswith("pilosa_tpu"):
+            continue
+        if rel.startswith("pilosa_tpu/analysis/"):
+            continue  # the analyzer's own docs show names on purpose
+        for name, line in _emit_sites(mod):
+            code.setdefault(name, (rel, line))
+    if not code:
+        return  # journal absent: nothing to check against
+
+    doc_path = root / "docs" / "observability.md"
+    doc_rel = "docs/observability.md"
+    if not doc_path.is_file():
+        yield Finding("event-names", doc_rel, 1,
+                      "docs/observability.md is missing")
+        return
+    doc_text = doc_path.read_text()
+    m = CATALOG.search(doc_text)
+    if m is None:
+        yield Finding("event-names", doc_rel, 1,
+                      "missing the events-catalog markers")
+        return
+    cat_line = doc_text.count("\n", 0, m.start()) + 1
+    docs = set(re.findall(r"^\| `([^`]+)`", m.group(1), re.M))
+
+    for name in sorted(code):
+        if name not in docs:
+            rel, line = code[name]
+            yield Finding("event-names", rel, line,
+                          f"event '{name}' is emitted but missing from "
+                          f"the docs/observability.md events catalog")
+    for d in sorted(docs):
+        if d not in code:
+            yield Finding("event-names", doc_rel, cat_line,
+                          f"events-catalog row '{d}' matches no "
+                          f"events.emit site")

@@ -213,13 +213,20 @@ def topn_from_rank(field, shards, n: int, stats=None):
     pairs = sorted(
         (Pair(r, c) for r, c in totals.items() if c > 0),
         key=lambda p: (-p.count, p.id))
+    from ..utils import explain as qexplain
     if bound == 0:
         if stats is not None:
             stats.count("rankcache.hit")
+        qexplain.note("caches", {"cache": "rank", "outcome": "prune",
+                                 "candidates": len(candidates),
+                                 "bound": 0})
         return pairs[:n] if n else pairs
     if n and len(pairs) >= n and pairs[n - 1].count > bound:
         if stats is not None:
             stats.count("rankcache.hit")
+        qexplain.note("caches", {"cache": "rank", "outcome": "prune",
+                                 "candidates": len(candidates),
+                                 "bound": bound})
         return pairs[:n]
     # coverage unproven: full scan, and mark churn-degraded caches so the
     # next query rebuilds them instead of falling back forever
@@ -228,4 +235,7 @@ def topn_from_rank(field, shards, n: int, stats=None):
             rc.invalidate()
     if stats is not None:
         stats.count("rankcache.fallback")
+    qexplain.note("caches", {"cache": "rank", "outcome": "fallback",
+                             "candidates": len(candidates),
+                             "bound": bound})
     return None

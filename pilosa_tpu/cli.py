@@ -353,13 +353,72 @@ def cmd_inspect(args) -> int:
     return 0
 
 
+def _top_cluster(args) -> int:
+    """``top --cluster``: poll /debug/cluster and render the fleet —
+    per-node qps/p99/HBM/hedges with staleness flags plus the tail of
+    the merged event timeline (docs/observability.md "Cluster
+    plane")."""
+    import time as _time
+
+    base = _base_url(args.host)
+    mb = 1 << 20
+    polls = 0
+    try:
+        while True:
+            c = _http("GET", f"{base}/debug/cluster")
+            nodes = c.get("nodes") or {}
+            print(f"-- pilosa-tpu fleet @ {args.host}  "
+                  f"coordinator {c.get('coordinator')}  "
+                  f"epoch {c.get('epoch')}  "
+                  f"overlay {c.get('overlayEpoch')}")
+            print(f"   {'node':<8} {'state':<8} {'qps':>7} {'p99ms':>8} "
+                  f"{'hbmMB':>7} {'evict':>6} {'retrc':>6} "
+                  f"{'hedges':>8} {'waves':>6} {'quar':>5} {'stale':>6}")
+            for nid in sorted(nodes):
+                n = nodes[nid]
+                stale = "-" if not n.get("stale") else (
+                    f"{n['staleS']:.0f}s" if n.get("staleS") is not None
+                    else "?")
+                p99 = n.get("p99Ms")
+                print(f"   {nid:<8} {n.get('state', '?'):<8} "
+                      f"{n.get('qps', 0):>7.1f} "
+                      f"{p99 if p99 is not None else '-':>8} "
+                      f"{n.get('hbmResidentBytes', 0) // mb:>7} "
+                      f"{n.get('evictions', '-'):>6} "
+                      f"{n.get('retraces', '-'):>6} "
+                      f"{str(n.get('hedges', '-')) + '/' + str(n.get('hedgeWins', '-')):>8} "
+                      f"{n.get('retryWaves', '-'):>6} "
+                      f"{n.get('quarantinedFragments', '-'):>5} "
+                      f"{stale:>6}")
+            tail = (c.get("timeline") or [])[-args.events:] \
+                if args.events > 0 else []
+            if tail:
+                print("   -- recent events")
+                for e in tail:
+                    extra = " ".join(
+                        f"{k}={v}" for k, v in e.items()
+                        if k not in ("event", "node", "wall", "seq"))
+                    print(f"   {e.get('node', '?'):<8} "
+                          f"{e.get('event')} {extra}")
+            polls += 1
+            if args.count and polls >= args.count:
+                return 0
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_top(args) -> int:
     """Live terminal summary of one node: poll /debug/timeseries +
     /debug/vars and render qps, p99, the HBM split, evictions/s, and
     compile/retrace counts — the operator loop for a box with no
-    Prometheus attached (docs/observability.md "Device runtime")."""
+    Prometheus attached (docs/observability.md "Device runtime").
+    ``--cluster`` renders the whole fleet from /debug/cluster
+    instead."""
     import time as _time
 
+    if args.cluster:
+        return _top_cluster(args)
     base = _base_url(args.host)
     mb = 1 << 20
     polls = 0
@@ -475,6 +534,8 @@ max-op-n = 10000
 # observability (docs/observability.md)
 # slow-query-threshold = 1 # seconds before a query lands in /debug/slow
 # slow-log-size = 128      # slow-query ring-buffer entries
+# slow-log-text-max = 512  # query-text chars stored per slow entry
+#                          # (over-ceiling entries marked textTruncated)
 # profile-default = false  # profile tree on every response, not just
 #                          # ?profile=true
 # trace-sample-rate = 1.0  # fraction of traces recorded (cluster-wide)
@@ -482,6 +543,12 @@ max-op-n = 10000
 #                          # 0 = sampler off
 # timeseries-window = 600  # seconds of history the time-series ring keeps
 # launch-ledger-size = 256 # /debug/launches ring entries
+# event-journal-size = 512 # /debug/events ring entries (breaker/node/
+#                          # quarantine/overlay/resize transitions)
+# event-log = false        # persist the journal to <data-dir>/events.log
+#                          # (length+CRC framed JSON records)
+# batch-temp-mb = 4096     # per-launch batch-temp workspace for fused
+#                          # [B, rows, W] row_counts/TopN device temps
 
 # elastic serving (docs/cluster.md "Read routing & rebalancing")
 # read-routing = "loaded"  # or "primary" (pin to jump-hash primary),
@@ -555,11 +622,15 @@ def cmd_config(args) -> int:
     print(f"repair-interval = {cfg.repair_interval}")
     print(f"slow-query-threshold = {cfg.slow_query_threshold}")
     print(f"slow-log-size = {cfg.slow_log_size}")
+    print(f"slow-log-text-max = {cfg.slow_log_text_max}")
     print(f"profile-default = {str(cfg.profile_default).lower()}")
     print(f"trace-sample-rate = {cfg.trace_sample_rate}")
     print(f"timeseries-interval = {cfg.timeseries_interval}")
     print(f"timeseries-window = {cfg.timeseries_window}")
     print(f"launch-ledger-size = {cfg.launch_ledger_size}")
+    print(f"event-journal-size = {cfg.event_journal_size}")
+    print(f"event-log = {str(cfg.event_log).lower()}")
+    print(f"batch-temp-mb = {cfg.batch_temp_mb}")
     print()
     print("[cluster]")
     print(f"hosts = [{', '.join(q(h) for h in cfg.cluster_hosts)}]")
@@ -661,6 +732,11 @@ def main(argv=None) -> int:
                     help="seconds between polls")
     sp.add_argument("--count", type=int, default=0,
                     help="polls before exiting (0 = forever)")
+    sp.add_argument("--cluster", action="store_true",
+                    help="render the fleet rollup (/debug/cluster): "
+                         "per-node summaries + merged event timeline")
+    sp.add_argument("--events", type=int, default=8,
+                    help="timeline entries shown per --cluster poll")
     sp.set_defaults(fn=cmd_top)
 
     sp = sub.add_parser("generate-config", help="print default config")

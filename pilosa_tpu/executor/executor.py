@@ -97,11 +97,31 @@ class _PendingGroup:
 # so arbitrary client batch sizes reuse a bounded set of compiled
 # executables instead of compiling one per distinct B (~20-40 s each
 # through an accelerator tunnel).
+#
+# Filtered row_counts/TopN batches ADDITIONALLY materialize one
+# [B, rows, W] masked temp per stacked shard (rows = the fragment row
+# count, usually >> P): sizing by P alone let bench configs 3-8 OOM
+# small-RAM hosts on both dispatch paths (BENCH_r07's skipped legs).
+# Callers pass that axis as ``row_weight`` so the chunking budget sees
+# the real per-B-row footprint.  The budget itself is the
+# ``batch-temp-mb`` knob (the decode-workspace pattern; process-wide,
+# most recent Server wins).
 BATCH_TEMP_BYTES = 4 << 30
 BATCH_CHUNK_MIN, BATCH_CHUNK_MAX = 8, 32768
 
 
-def _batch_chunks(params_mat: np.ndarray, n_shards: int):
+def batch_chunk_size(P: int, n_shards: int, row_weight: int = 0) -> int:
+    """Pow-2 batch-axis chunk size under the batch-temp workspace —
+    THE sizing formula, shared by _batch_chunks, the whole-query chunk
+    guard, and the cross-query batcher's fusion cap."""
+    weight = max(1, P, row_weight) * n_shards * SHARD_WORDS * 4
+    chunk = max(BATCH_CHUNK_MIN,
+                min(BATCH_CHUNK_MAX, BATCH_TEMP_BYTES // weight))
+    return 1 << (chunk.bit_length() - 1)
+
+
+def _batch_chunks(params_mat: np.ndarray, n_shards: int,
+                  row_weight: int = 0):
     """Yield (lo, n, padded_params) covering params_mat[lo:lo+n]; padded
     rows beyond n are duplicates whose results the caller ignores.
     ``n_shards`` is the per-device stacked-shard count — gather temps
@@ -109,15 +129,14 @@ def _batch_chunks(params_mat: np.ndarray, n_shards: int):
     total shard count.  ``n_shards <= 0`` marks a filter-less group whose
     device pass is a B-independent broadcast: it dispatches as ONE chunk
     regardless of B (splitting would repeat the full fragment pass per
-    chunk — r5 advisor, the old path still cut at BATCH_CHUNK_MAX)."""
+    chunk — r5 advisor, the old path still cut at BATCH_CHUNK_MAX).
+    ``row_weight``: the rows axis of a [B, rows, W] masked temp
+    (filtered row_counts/TopN), 0 for gather-temp-only kinds."""
     B, P = params_mat.shape
     if n_shards <= 0:
         chunk = max(BATCH_CHUNK_MIN, B)
     else:
-        weight = max(1, P) * n_shards * SHARD_WORDS * 4
-        chunk = max(BATCH_CHUNK_MIN,
-                    min(BATCH_CHUNK_MAX, BATCH_TEMP_BYTES // weight))
-        chunk = 1 << (chunk.bit_length() - 1)
+        chunk = batch_chunk_size(P, n_shards, row_weight)
     for lo in range(0, B, chunk):
         sub = params_mat[lo: lo + chunk]
         n = sub.shape[0]
@@ -183,6 +202,15 @@ def _run_batched_groups(batcher, holder, index, shards, groups, results):
         # filter broadcast one pass — single chunk (see _batch_chunks)
         return per_dev if (kind == "count" or slotted is not None) else 0
 
+    def _row_weight(kind, slotted, extra):
+        # filtered row_counts launches materialize a [B, rows, W]
+        # masked temp per stacked shard: the rows axis must size the
+        # chunk budget (BENCH_r07's small-RAM OOM gap)
+        if kind != "topn" or slotted is None:
+            return 0
+        from ..parallel.mesh_exec import field_rows
+        return field_rows(holder, index, extra["field"], extra["view"])
+
     # chunk layouts computed ONCE; on the multi-slice direct path the
     # padded params also go to device once (slice-major iteration would
     # otherwise repeat the concatenate padding and the host->device
@@ -192,8 +220,14 @@ def _run_batched_groups(batcher, holder, index, shards, groups, results):
     group_chunks = [
         [(lo, n_c, sub if fuse else jnp.asarray(sub))
          for lo, n_c, sub in
-         _batch_chunks(params_mat, _n_split(kind, slotted))]
+         _batch_chunks(params_mat, _n_split(kind, slotted),
+                       _row_weight(kind, slotted, extra))]
         for kind, slotted, params_mat, _ci, extra in groups]
+    # the batch axis split to honor the workspace: visible, not silent
+    # (docs/observability.md — `query.batch_temp_splits`)
+    n_splits = sum(len(ch) - 1 for ch in group_chunks if len(ch) > 1)
+    if n_splits:
+        batcher.stats.count("query.batch_temp_splits", n_splits)
 
     parts_acc: dict[tuple[int, int], list] = {}
     for shard_slice in sched:
@@ -527,6 +561,16 @@ class Executor:
                     span.set_tag("outcome", outcome)
                     if pnode is not None:
                         pnode.tags["outcome"] = outcome
+                from ..utils import explain as qexplain
+                qexplain.note("caches", {
+                    "cache": "result", "scope": "local",
+                    "outcome": outcome,
+                    # the key COMPONENTS, not the raw key: what would
+                    # have to change for this entry to stop matching
+                    "key": {"index": index_name, "shards": len(shards),
+                            "genVector": hash(ckey[5]) & 0xFFFFFFFF,
+                            "schemaEpoch": ckey[6],
+                            "attrEpoch": ckey[7]}})
                 if out is not None:
                     return out
         if isinstance(query, str):
@@ -539,6 +583,12 @@ class Executor:
                         pnode.tags["outcome"] = "hit" if hit else "miss"
                 if hit:
                     stats.count("query.prepared.hit")
+                    from ..utils import explain as qexplain
+                    # the replay's launch already noted its wholequery
+                    # program (or fell back inside the template); this
+                    # entry records that the PREPARED cache drove it
+                    qexplain.note("plan", {"mode": "prepared",
+                                           "shards": len(shards or ())})
                     if ckey is not None:
                         # prepared entries exist only for Count/Sum/TopN
                         # templates — read-only by construction
@@ -589,9 +639,18 @@ class Executor:
                 pass
             elif self.mesh_exec is not None and len(query.calls) > 1 and \
                     read_only:
+                from ..utils import explain as qexplain
+                qexplain.note("plan", {"mode": "legacy-grouped",
+                                       "calls": len(query.calls),
+                                       "shards": len(shards)})
                 results = self._execute_calls_grouped(index_name,
                                                       query.calls, shards)
             else:
+                from ..utils import explain as qexplain
+                qexplain.note("plan", {"mode": "legacy-per-call",
+                                       "calls": len(query.calls),
+                                       "readOnly": read_only,
+                                       "shards": len(shards)})
                 results = []
                 for c in query.calls:
                     check_current("call dispatch")
@@ -724,6 +783,11 @@ class Executor:
         self.wq_last_fallback = e.node if not e.detail \
             else f"{e.node}: {e.detail}"
         self.stats.count("wholequery.fallback")
+        from ..utils import events, explain as qexplain
+        events.emit("wholequery.fallback", index=index, node=e.node,
+                    detail=e.detail or None)
+        qexplain.note("plan", {"mode": "legacy-fallback", "node": e.node,
+                               "detail": e.detail or None})
         log = self.logger
         if log is not None:
             try:
@@ -747,20 +811,18 @@ class Executor:
                                         self.holder, index, shards)
 
     @staticmethod
-    def _wq_chunk_guard(mat: np.ndarray, n_split: int):
+    def _wq_chunk_guard(mat: np.ndarray, n_split: int,
+                        row_weight: int = 0):
         """A params batch needing more than one dispatch chunk (device
-        gather-temp budget) stays on the legacy chunked path.  Pure
-        arithmetic — the same sizing as _batch_chunks, without
-        materializing a padded chunk just to count them."""
+        temp budget) stays on the legacy chunked path.  Pure arithmetic
+        — the same batch_chunk_size sizing as _batch_chunks (including
+        the [B, rows, W] row_weight axis for filtered row_counts),
+        without materializing a padded chunk just to count them."""
         from ..parallel.wholequery import WholeQueryUnsupported
         B, P = mat.shape
         if n_split <= 0:
             return  # broadcast pass: always one chunk
-        weight = max(1, P) * n_split * SHARD_WORDS * 4
-        chunk = max(BATCH_CHUNK_MIN,
-                    min(BATCH_CHUNK_MAX, BATCH_TEMP_BYTES // weight))
-        chunk = 1 << (chunk.bit_length() - 1)
-        if B > chunk:
+        if B > batch_chunk_size(P, n_split, row_weight):
             raise WholeQueryUnsupported("batch-chunks", f"B={B}")
 
     def _wq_run_batched(self, index: str, shards, groups, results):
@@ -780,7 +842,13 @@ class Executor:
         for kind, slotted, params_mat, call_idxs, extra in groups:
             n_split = per_dev if (kind == "count" or slotted is not None) \
                 else 0
-            self._wq_chunk_guard(params_mat, n_split)
+            row_weight = 0
+            if kind == "topn" and slotted is not None:
+                from ..parallel.mesh_exec import field_rows
+                row_weight = field_rows(self.holder, index,
+                                        extra["field"],
+                                        extra.get("view", _STD))
+            self._wq_chunk_guard(params_mat, n_split, row_weight)
             if kind == "count":
                 nodes.append(ReduceNode("count", slotted))
             elif kind == "sum":
@@ -792,6 +860,11 @@ class Executor:
                     (extra["field"], extra.get("view", _STD))))
             mats.append(params_mat)
         out = self._wq_dispatch(index, shards, tuple(nodes), mats)
+        from ..utils import explain as qexplain
+        qexplain.note("plan", {
+            "mode": "wholequery", "program": out.sig,
+            "nodes": [n.kind for n in nodes],
+            "shards": len(shards)})
         mesh = self.mesh_exec
         for gi, (kind, slotted, params_mat, call_idxs, extra) \
                 in enumerate(groups):
@@ -865,8 +938,12 @@ class Executor:
                 mats.append(mat)
             elif kind == "topn":
                 mat = np.stack([d["params"] for d in ds])
+                from ..parallel.mesh_exec import field_rows
                 self._wq_chunk_guard(
-                    mat, per_dev if d0["slotted"] is not None else 0)
+                    mat, per_dev if d0["slotted"] is not None else 0,
+                    row_weight=field_rows(self.holder, index,
+                                          d0["field"], VIEW_STANDARD)
+                    if d0["slotted"] is not None else 0)
                 nodes.append(ReduceNode("row_counts", d0["slotted"],
                                         (d0["field"], VIEW_STANDARD)))
                 mats.append(mat)
@@ -903,6 +980,15 @@ class Executor:
             unit_nodes.append((lo, len(nodes)))
 
         out = self._wq_dispatch(index, shards, tuple(nodes), mats)
+        from ..utils import explain as qexplain
+        qexplain.note("plan", {
+            "mode": "wholequery",
+            # the compiled program's devobs signature — the SAME id the
+            # compile registry and launch ledger record, so the explain
+            # record cross-checks the ledger (None = empty launch)
+            "program": out.sig,
+            "nodes": [n.kind for n in nodes],
+            "calls": len(calls), "shards": len(shards)})
         for u, (lo, hi) in zip(units, unit_nodes):
             self._wq_wire(u, out, lo, hi, results)
         return results

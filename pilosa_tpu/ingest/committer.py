@@ -105,6 +105,9 @@ class GroupCommitter:
         self.flushes = 0
         self.records_total = 0
         self.folds = 0
+        # backpressure episode latch: engage journaled at the first
+        # refusal, release at the flush that drains under the mark
+        self._backpressure = False
 
     def _ensure_thread(self):
         if self._thread is None and self.flush_ms > 0:
@@ -184,16 +187,30 @@ class GroupCommitter:
     def wait_capacity(self, timeout: float = 0.5) -> bool:
         """Backpressure gate: True when the unflushed backlog is under
         the high-water mark (possibly after waiting for a flush), False
-        when the producer should be rejected with 503 + Retry-After."""
+        when the producer should be rejected with 503 + Retry-After.
+        The ENGAGE transition (first refusal of a backpressure episode)
+        is journaled; the matching RELEASE is journaled by the flush
+        that drains the backlog back under the mark."""
         deadline = time.monotonic() + timeout
+        engaged = False
         with self._cond:
             while self._pend_bytes >= self.HIGH_WATER_BYTES:
                 self._cond.notify_all()
                 left = deadline - time.monotonic()
                 if left <= 0 or self.flush_ms <= 0:
-                    return False
+                    if not self._backpressure:
+                        self._backpressure = True
+                        engaged = True
+                    break
                 self._cond.wait(min(left, 0.05))
-            return True
+            else:
+                return True
+        if engaged:
+            from ..utils import events
+            events.emit("ingest.backpressure_engage",
+                        backlogBytes=self._pend_bytes,
+                        highWaterBytes=self.HIGH_WATER_BYTES)
+        return False
 
     # -- flusher side ------------------------------------------------------
 
@@ -267,7 +284,15 @@ class GroupCommitter:
             self._flushed_seq = max(self._flushed_seq, seq)
             for frag in touched:
                 self._journal_frags[frag] = self._flush_no
+            released = self._backpressure \
+                and self._pend_bytes < self.HIGH_WATER_BYTES
+            if released:
+                self._backpressure = False
             self._cond.notify_all()
+        if released:
+            from ..utils import events
+            events.emit("ingest.backpressure_release",
+                        backlogBytes=self.pending_bytes())
         if pend and self.stats is not None:
             self.stats.timing("ingest.flush", time.perf_counter() - t0)
             self.stats.count("ingest.flushes")

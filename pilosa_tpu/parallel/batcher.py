@@ -50,6 +50,7 @@ from contextlib import contextmanager
 
 import numpy as np
 
+from ..core import SHARD_WORDS
 from ..executor.plan import parametrize, plan_inputs
 from ..utils import devobs
 from ..utils import profile as qprof
@@ -73,14 +74,22 @@ FUSED_ROWS_MAX = 4096
 class _Ticket:
     __slots__ = ("kind", "key", "params", "scalar", "payload", "ctx",
                  "enq", "future", "background", "trace", "prof",
-                 "prof_node")
+                 "prof_node", "temp_weight")
 
-    def __init__(self, kind, key, params, scalar, payload, background):
+    def __init__(self, kind, key, params, scalar, payload, background,
+                 temp_weight: int = 0):
         self.kind = kind
         self.key = key
         self.params = params          # [B_local, P] int32
         self.scalar = scalar          # True: un-vmapped caller, scatter p[i]
         self.payload = payload
+        # device-temp bytes one fused B-row of this ticket costs (the
+        # [B, rows, W] masked temp of filtered row_counts; 0 = only the
+        # FUSED_ROWS_MAX row cap applies).  The fusion packer bounds
+        # SUM(rows x weight) by the batch-temp workspace — fusing k
+        # over-sized tickets multiplied the temp k-fold and OOM'd
+        # small-RAM hosts (the BENCH_r07 sizing gap).
+        self.temp_weight = temp_weight
         self.ctx = current()          # the submitting query's deadline
         # trace + profile context cross the dispatcher-thread boundary
         # with the ticket (a thread-local would silently drop them):
@@ -122,6 +131,7 @@ class DispatchBatcher:
         self.single_launches = 0
         self.stream_fallbacks = 0
         self.expired_drops = 0
+        self.temp_splits = 0  # fusion packs split by the temp workspace
         self.batch_size_hist = BucketHistogram([1, 2, 4, 8, 16, 32, 64])
         self.window_wait = ReservoirTimer(512)
 
@@ -155,10 +165,11 @@ class DispatchBatcher:
         return (self.enabled and not self.mesh.multiprocess
                 and threading.get_ident() != self._tid)
 
-    def _submit(self, kind, key, params, scalar, payload):
+    def _submit(self, kind, key, params, scalar, payload,
+                temp_weight: int = 0):
         bg = getattr(self._bg_local, "flag", False)
         t = _Ticket(kind, key, np.ascontiguousarray(params, dtype=np.int32),
-                    scalar, payload, bg)
+                    scalar, payload, bg, temp_weight=temp_weight)
         with self._cond:
             if self._closed:
                 return None
@@ -231,6 +242,19 @@ class DispatchBatcher:
             return None, _EMPTY_PARAMS
         return parametrize(filter_plan)
 
+    def _rowcount_weight(self, field, view, slotted, holder, index,
+                         shards) -> int:
+        """Per-fused-B-row device-temp bytes of a filtered row_counts
+        launch ([rows, W] masked temp per stacked shard per device) —
+        the fusion packer's batch-temp workspace unit.  0 for the
+        filter-less broadcast pass (B-independent)."""
+        if slotted is None:
+            return 0
+        from .mesh_exec import field_rows
+        rows = field_rows(holder, index, field, view)
+        per_dev = self.mesh.stacked_per_device(max(len(shards), 1))
+        return rows * per_dev * SHARD_WORDS * 4
+
     def row_counts_async(self, field, view, filter_plan, holder, index,
                          shards) -> list:
         if not self._use_ticket():
@@ -244,7 +268,9 @@ class DispatchBatcher:
             np.asarray(params, dtype=np.int32).reshape(1, -1), True,
             {"filter_plan": filter_plan, "slotted": slotted, "field": field,
              "view": view, "holder": holder, "index": index,
-             "shards": list(shards)})
+             "shards": list(shards)},
+            temp_weight=self._rowcount_weight(field, view, slotted,
+                                              holder, index, shards))
         if out is None:
             return self.mesh.row_counts_async(field, view, filter_plan,
                                               holder, index, shards)
@@ -303,11 +329,24 @@ class DispatchBatcher:
             key = key + ("nofuse", next(self._wq_nofuse))
         rows = sum(m[0].shape[0] if isinstance(m, tuple) else m.shape[0]
                    for m in mats)
+        # batch-temp weight: every FILTERED row_counts node of the
+        # program adds a [B, rows, W] masked temp per stacked shard —
+        # fusing programs multiplies them, so the packer must see it
+        from .mesh_exec import field_rows
+        weight = 0
+        for node in program:
+            if node.kind == "row_counts" and node.plan is not None:
+                f_name, v_name = node.primary
+                weight += (field_rows(holder, index, f_name, v_name)
+                           * self.mesh.stacked_per_device(
+                               max(len(shards), 1))
+                           * SHARD_WORDS * 4)
         out = self._submit(
             "wholequery", key,
             np.zeros((max(rows, 1), 0), dtype=np.int32), False,
             {"runner": runner, "program": program, "mats": mats,
-             "holder": holder, "index": index, "shards": list(shards)})
+             "holder": holder, "index": index, "shards": list(shards)},
+            temp_weight=weight)
         if out is None:  # closed mid-flight: direct
             return runner.run(program, mats, holder, index, shards)
         return out
@@ -339,7 +378,9 @@ class DispatchBatcher:
                  tuple(shards), id(holder)),
                 params_mat, False,
                 {"slotted": slotted, "field": field, "view": view,
-                 "holder": holder, "index": index, "shards": list(shards)})
+                 "holder": holder, "index": index, "shards": list(shards)},
+                temp_weight=self._rowcount_weight(field, view, slotted,
+                                                  holder, index, shards))
             if out is not None:
                 return out
         return self.mesh.row_counts_batch_async(
@@ -417,20 +458,34 @@ class DispatchBatcher:
                 self.stats.count("dispatch.expired_drop")
                 continue
             groups.setdefault(t.key, []).append(t)
+        from ..executor import executor as _exec_mod
         for key, tickets in groups.items():
-            # foreground first, then pack under the ticket and fused-row
-            # caps; an over-cap ticket launches alone (un-fused)
+            # foreground first, then pack under the ticket, fused-row,
+            # and batch-temp-workspace caps; an over-cap ticket launches
+            # alone (un-fused)
             tickets.sort(key=lambda t: t.background)
             pack: list[_Ticket] = []
             rows = 0
+            temp = 0
             for t in tickets:
                 n = t.params.shape[0]
+                cost = n * t.temp_weight
+                over_temp = pack and t.temp_weight > 0 and \
+                    temp + cost > _exec_mod.BATCH_TEMP_BYTES
+                if over_temp:
+                    # fusing this ticket would exceed the batch-temp
+                    # workspace ([B, rows, W] temps scale with the
+                    # fused row count): split the pack, visibly
+                    self.temp_splits += 1
+                    self.stats.count("dispatch.fused_temp_split")
                 if pack and (len(pack) >= self.max_batch
-                             or rows + n > FUSED_ROWS_MAX):
+                             or rows + n > FUSED_ROWS_MAX
+                             or over_temp):
                     self._launch(key[0], pack)
-                    pack, rows = [], 0
+                    pack, rows, temp = [], 0, 0
                 pack.append(t)
                 rows += n
+                temp += cost
             if pack:
                 self._launch(key[0], pack)
 
@@ -718,6 +773,7 @@ class DispatchBatcher:
             "singleLaunches": self.single_launches,
             "streamFallbacks": self.stream_fallbacks,
             "expiredDrops": self.expired_drops,
+            "tempSplits": self.temp_splits,
             "batchSize": self.batch_size_hist.snapshot(),
             "windowWaitS": self.window_wait.snapshot(),
         }

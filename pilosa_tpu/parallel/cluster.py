@@ -52,6 +52,8 @@ from ..executor.results import (
 from ..pql import Call, Query, parse
 from ..pql.wire import call_from_wire, call_to_wire
 from ..utils import degraded
+from ..utils import events
+from ..utils import explain as qexplain
 from ..utils import profile as qprof
 from ..utils.deadline import DEADLINE_HEADER, current as current_ctx
 from ..utils.faults import FAULTS
@@ -157,7 +159,7 @@ class _Breaker:
     """Per-peer circuit breaker state (closed -> open -> half-open)."""
 
     __slots__ = ("fails", "state", "opened_at", "trial_inflight",
-                 "opened_total", "fast_fails")
+                 "opened_total", "fast_fails", "half_open_emitted")
 
     def __init__(self):
         self.fails = 0
@@ -166,6 +168,11 @@ class _Breaker:
         self.trial_inflight = False
         self.opened_total = 0
         self.fast_fails = 0
+        # breaker.half_open journals once per OPEN episode, not once per
+        # admitted trial: probes are always admitted as trials, so a
+        # dead peer would otherwise emit every health interval and flood
+        # the bounded event ring for the whole outage
+        self.half_open_emitted = False
 
 
 class InternalClient:
@@ -250,6 +257,7 @@ class InternalClient:
         if self.breaker_threshold <= 0:
             return
         b = self._breaker(host)
+        admitted = emit_half_open = False
         with self._breaker_lock:
             if b.state == "closed":
                 return
@@ -257,10 +265,22 @@ class InternalClient:
             if trial or (now - b.opened_at >= self.breaker_cooldown
                          and not b.trial_inflight):
                 b.trial_inflight = True  # half-open trial
-                return
-            b.fast_fails += 1
-            if self.stats is not None:
-                self.stats.count("breaker.fail_fast")
+                admitted = True
+                emit_half_open = not b.half_open_emitted
+                b.half_open_emitted = True
+            else:
+                b.fast_fails += 1
+                if self.stats is not None:
+                    self.stats.count("breaker.fail_fast")
+        if admitted:
+            if emit_half_open:
+                # journaled OUTSIDE the breaker lock (events is a leaf
+                # lock; transitions are rare, never the fail-fast hot
+                # path) and once per open EPISODE — probes are always
+                # admitted as trials, so per-trial emission would flood
+                # the ring for a whole outage
+                events.emit("breaker.half_open", host=host)
+            return
         raise CircuitOpenError(
             f"circuit open for {host} ({b.fails} consecutive failures); "
             f"failing fast")
@@ -278,14 +298,19 @@ class InternalClient:
         if b.state == "closed" and b.fails == 0:
             return
         with self._breaker_lock:
+            was_open = b.state == "open"
             b.fails = 0
             b.trial_inflight = False
+            b.half_open_emitted = False
             b.state = "closed"
+        if was_open:
+            events.emit("breaker.close", host=host)
 
     def _breaker_failure(self, host: str):
         if self.breaker_threshold <= 0:
             return
         b = self._breaker(host)
+        opened = False
         with self._breaker_lock:
             b.trial_inflight = False
             b.fails += 1
@@ -296,8 +321,12 @@ class InternalClient:
                 b.state = "open"
                 b.opened_at = now
                 b.opened_total += 1
+                b.half_open_emitted = False
+                opened = True
                 if self.stats is not None:
                     self.stats.count("breaker.opened")
+        if opened:
+            events.emit("breaker.open", host=host, fails=b.fails)
 
     def breaker_snapshot(self) -> dict:
         """Per-peer breaker state for /debug/vars."""
@@ -495,6 +524,26 @@ class InternalClient:
         headers = {PROBE_HEADER: "1"} if probe else None
         return self._json(host, "GET", "/status", timeout=timeout,
                           headers=headers, breaker_trial=probe)
+
+    def debug_vars(self, host: str, timeout: float | None = None) -> dict:
+        """One peer's /debug/vars snapshot — the fleet rollup's pull
+        (parallel/rollup.py).  Probe-tagged on the wire (background
+        traffic) and subject to the breaker like any other RPC, but NOT
+        a breaker trial: the rollup must never be the thing that closes
+        a breaker the probes haven't vetted."""
+        return self._json(host, "GET", "/debug/vars", timeout=timeout,
+                          headers={PROBE_HEADER: "1"})
+
+    def debug_events(self, host: str, since: int = 0,
+                     timeout: float | None = None,
+                     limit: int | None = None) -> dict:
+        """One peer's event journal after ``since`` (the /debug/events
+        cursor contract, utils/events.py)."""
+        path = f"/debug/events?since={int(since)}"
+        if limit is not None:
+            path += f"&limit={int(limit)}"
+        return self._json(host, "GET", path, timeout=timeout,
+                          headers={PROBE_HEADER: "1"})
 
     @staticmethod
     def _deadline_extras(deadline_s, base_timeout):
@@ -1060,6 +1109,9 @@ class Cluster:
         if isinstance(err, ConnectionRefusedError) \
                 or n.state == NODE_DOWN \
                 or n.probe_fails >= self.health_down_threshold:
+            if n.state != NODE_DOWN:
+                events.emit("node.down", peer=n.id,
+                            reason=f"{type(err).__name__}: {err}"[:160])
             n.state = NODE_DOWN
 
     def probe_peers(self):
@@ -1090,6 +1142,8 @@ class Cluster:
                 continue
             n.probe_fails = 0
             n.state = NODE_READY
+            if was_down:
+                events.emit("node.up", peer=n.id)
             # fold the probe's piggybacked gen summaries into the result-
             # cache registry: writes that entered the cluster through
             # OTHER nodes (never crossing this coordinator) stop matching
@@ -1185,6 +1239,9 @@ class Cluster:
     def _mark_down(self, node_id: str):
         n = self.by_id.get(node_id)
         if n is not None:
+            if n.state != NODE_DOWN:
+                events.emit("node.down", peer=node_id,
+                            reason="marked down by fan-out/broadcast")
             n.state = NODE_DOWN
             self._update_state()
 
@@ -1253,6 +1310,8 @@ class Cluster:
                 return False
             extras.append(node_id)
             self.overlay_epoch += 1
+        events.emit("overlay.handoff", index=index, shard=shard,
+                    to=node_id, epoch=self.overlay_epoch)
         self._save_topology()
         self.broadcast_overlay()
         return True
@@ -1296,6 +1355,10 @@ class Cluster:
     # which only costs a preference, never correctness)
     RESIDENCY_MAX_SHARDS = 2048
     RESIDENCY_CACHE_TTL = 2.0
+    # One query firing this many speculative duplicates is a hedge storm
+    # (journaled once per query in the event timeline): the cluster is
+    # tail-degrading broadly, not routing around one slow peer.
+    HEDGE_STORM_MIN = 4
 
     def residency_summary(self) -> dict:
         """Per-index shard residency tiers this node can serve from:
@@ -1587,6 +1650,13 @@ class Cluster:
                         pnode.tags["outcome"] = \
                             "hit" if out is not None else "miss"
                         pnode.tags["scope"] = "cluster"
+                qexplain.note("caches", {
+                    "cache": "result", "scope": "cluster",
+                    "outcome": "hit" if out is not None else "miss",
+                    "key": {"index": index, "shards": len(shards),
+                            "genVector": hash(local_part[0]) & 0xFFFFFFFF,
+                            "peerWriteVector": hash(local_part[3])
+                            & 0xFFFFFFFF}})
                 if out is not None:
                     return out
         if len(query.calls) > 1 and \
@@ -1733,6 +1803,7 @@ class Cluster:
         attempts: list[dict] = []  # per-node attempt log (error surface)
         last_err: Exception | None = None
         partial_counted = False
+        hedges_fired = 0  # this query's speculative duplicates
         # one in-flight dispatch per future.  First-answer-wins is
         # per-SHARD-SET with all-or-nothing acceptance: a flight's
         # results are per-group AGGREGATES (a Count over its whole
@@ -1762,6 +1833,9 @@ class Cluster:
             # per-shard load counters the balancer watches
             self.router.note_dispatch(nid, len(nshards))
             self.load_tracker.note(index, nshards, nid)
+            qexplain.note("dispatch", {
+                "node": nid, "shards": [int(s) for s in nshards[:64]],
+                "wave": wave, "hedge": hedge})
 
             # the router's RTT sample is timed INSIDE the pool worker:
             # the consumption loop's elapsed also counts local execution
@@ -1798,6 +1872,10 @@ class Cluster:
         def run_local(nshards: list[int], wave: int):
             self.router.note_dispatch(self.node_id, len(nshards))
             self.load_tracker.note(index, nshards, self.node_id)
+            qexplain.note("dispatch", {
+                "node": self.node_id,
+                "shards": [int(s) for s in nshards[:64]],
+                "wave": wave, "local": True})
             t_local = time.perf_counter()
             try:
                 with stats.timer("cluster.multi.local_exec"), \
@@ -1912,6 +1990,9 @@ class Cluster:
             if fl["hedge"]:
                 stats.count("cluster.hedge_wins")
                 self.router.note_hedge_win(fl["nid"])
+                qexplain.note("hedges", {"outcome": "won",
+                                         "node": fl["nid"],
+                                         "shards": len(fl["shards"])})
             if peer_quarantined:
                 # peer answered with quarantined fragments serving
                 # empty: surface it on THIS response (consumed on the
@@ -2035,6 +2116,18 @@ class Cluster:
                         for nid, nshards in groups.items():
                             stats.count("cluster.hedges")
                             self.router.note_hedge(nid)
+                            qexplain.note("hedges", {
+                                "outcome": "fired", "node": nid,
+                                "insteadOf": fl["nid"],
+                                "shards": len(nshards)})
+                            hedges_fired += 1
+                            if hedges_fired == self.HEDGE_STORM_MIN:
+                                # one query speculating this widely is a
+                                # tail-latency incident, not routine
+                                # hedging — journal it once per query
+                                events.emit("cluster.hedge_storm",
+                                            index=index,
+                                            hedges=hedges_fired)
                             submit(nid, nshards, fl["wave"],
                                    hedge=True)
         finally:
@@ -2683,6 +2776,8 @@ class Cluster:
                 repaired += 1
                 if self.stats is not None:
                     self.stats.count("antientropy.repairs")
+                events.emit("storage.repair", index=iname, field=fname,
+                            view=vname, shard=shard, source=nid)
                 break
         return repaired
 
@@ -3310,6 +3405,8 @@ class Cluster:
                                    replica_n=self.replica_n,
                                    hasher=self.placement.hasher)
         self.epoch = msg_epoch
+        events.emit("cluster.resize", epoch=msg_epoch,
+                    nodes=[m["id"] for m in membership])
         # a membership resize reshuffles jump-hash placement wholesale:
         # the overlay (tuned for the OLD placement) is dropped on every
         # node and the balancer re-detects hot spots under the new

@@ -153,6 +153,19 @@ def _unpack_frags(layout, arrays):
 _DISPATCH_LOCK = make_lock("dispatch")
 
 
+def field_rows(holder, index: str, field: str, view: str) -> int:
+    """Max fragment row count for (field, view) — the ``rows`` axis of
+    a batched/fused row_counts launch's [B, rows, W] masked temp, fed
+    into the batch-temp workspace sizing (executor.batch_chunk_size and
+    the batcher's fusion cap).  0 when the view holds no fragments."""
+    idx = holder.index(index)
+    f = idx.field(field) if idx is not None else None
+    v = f.view(view) if f is not None else None
+    if v is None:
+        return 0
+    return max((fr.n_rows for fr in v.fragments.values()), default=0)
+
+
 class _InstrumentedExec:
     """One compiled shard_map executable plus its device-runtime
     telemetry (utils/devobs.py, docs/observability.md "Device runtime").
@@ -220,8 +233,15 @@ class _InstrumentedExec:
             slice_pos=_devobs.current_slice())
         prof = qprof.current()
         if prof is not None:
+            # rows/padding/decode tags feed the EXPLAIN launches section
+            # (utils/explain.py) — the same numbers the ledger records,
+            # so an explain record cross-checks the ledger by sig
             prof.event("device.launch", dt, kind=self.kind, sig=self.sig,
-                       shards=shards, compiled=compiled)
+                       shards=shards, shardsPadded=shards_pad,
+                       batchRows=rows if rows is not None else b_pad,
+                       batchRowsPadded=b_pad,
+                       decodeBytes=self.decode_per_shard * shards,
+                       compiled=compiled)
         return out
 
 

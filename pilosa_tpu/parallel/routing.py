@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import time
 
+from ..utils import explain as qexplain
 from ..utils.locks import make_lock
 
 # EWMA smoothing for per-peer RTT: new = (1-a)*old + a*sample.
@@ -245,7 +246,14 @@ class ReadRouter:
         groups: dict[str, list[int]] = {}
         scores: dict[str, float | None] = {}
         fell_back = False
+        # EXPLAIN (utils/explain.py): per-shard choice + score breakdown
+        # collected only when a record is active, and only WHILE the
+        # routing section has capacity — past the cap a minimal note
+        # keeps the truncation counted without building the per-
+        # candidate breakdowns the record would drop anyway
+        explain_active = qexplain.active()
         for s in shards:
+            want_explain = explain_active and qexplain.wants("routing")
             # legacy candidate order exactly (the cluster's
             # _ready_owner_order — overlay-aware — plus the exclude
             # filter): ready owners, or ALL owners when none are ready.
@@ -257,9 +265,11 @@ class ReadRouter:
             if not candidates:
                 raise ClusterError(
                     f"no available node for shard {s} of {index!r}")
+            pre_skip = list(candidates)
             candidates = self._skip_open_breakers(candidates)
             primary_pick = cluster.node_id \
                 if cluster.node_id in candidates else candidates[0]
+            breakdown = None
             if policy == "primary" or len(candidates) == 1:
                 pick = primary_pick
             elif policy == "round-robin":
@@ -267,10 +277,27 @@ class ReadRouter:
             else:  # loaded
                 if local_res is None and self.residency_routing:
                     local_res = cluster.residency_summary()
+                breakdown = {} if want_explain else None
                 pick, fb = self._pick_loaded(index, int(s), candidates,
                                              primary_pick, scores, now,
-                                             local_res)
+                                             local_res,
+                                             breakdown=breakdown)
                 fell_back = fell_back or fb
+            if want_explain:
+                entry = {"shard": int(s), "chosen": pick,
+                         "policy": policy,
+                         "candidates": list(candidates)}
+                skipped = [nid for nid in pre_skip
+                           if nid not in candidates]
+                if skipped:
+                    entry["breakerSkipped"] = skipped
+                if breakdown:
+                    entry["scores"] = breakdown
+                qexplain.note("routing", entry)
+            elif explain_active:
+                # over the section cap: dropped by note(), but counted
+                # in the record's `truncated` so overflow stays visible
+                qexplain.note("routing", {"shard": int(s)})
             groups.setdefault(pick, []).append(s)
         if fell_back:
             with self._lock:
@@ -302,14 +329,17 @@ class ReadRouter:
 
     def _pick_loaded(self, index: str, shard: int, candidates: list[str],
                      primary_pick: str, score_cache: dict, now: float,
-                     local_res) -> tuple[str, bool]:
+                     local_res, breakdown: dict | None = None
+                     ) -> tuple[str, bool]:
         """Scored choice: EWMA RTT x queue pressure, discounted for
         residency.  A candidate with no RTT history yet scores with the
         cheapest KNOWN candidate's EWMA (optimistic default — a
         never-tried replica must stay explorable, or the first-served
         node would keep every shard forever); when EVERY candidate is
         unknown the router falls back to the primary choice (returned
-        flag counts ``routing.fallback``)."""
+        flag counts ``routing.fallback``).  ``breakdown``: optional dict
+        filled with each candidate's score components (the EXPLAIN
+        routing section)."""
         infos = []
         for nid in candidates:
             if nid not in score_cache:
@@ -317,6 +347,8 @@ class ReadRouter:
             infos.append((nid,) + score_cache[nid])
         known = [ewma for _, ewma, _ in infos if ewma is not None]
         if not known:
+            if breakdown is not None:
+                breakdown["fallback"] = "no-rtt-history"
             return primary_pick, True
         default_ewma = min(known)
         local_id = self.cluster.node_id
@@ -326,6 +358,7 @@ class ReadRouter:
             score = (ewma if ewma is not None else default_ewma) * pressure
             if nid == local_id:
                 score *= LOCAL_BIAS
+            frac = 0.0
             if self.residency_routing:
                 if nid == local_id:
                     frac = self._local_tier(local_res, index, shard)
@@ -335,6 +368,15 @@ class ReadRouter:
                                                            now) \
                             if nid in self._peers else 0.0
                 score = score * (1.0 - RESIDENCY_DISCOUNT * frac)
+            if breakdown is not None:
+                breakdown[nid] = {
+                    "ewmaMs": round((ewma if ewma is not None
+                                     else default_ewma) * 1e3, 3),
+                    "ewmaDefaulted": ewma is None,
+                    "pressure": round(pressure, 3),
+                    "residencyTier": frac,
+                    "localBias": nid == local_id,
+                    "score": round(score * 1e3, 4)}
             if best_score is None or score < best_score:
                 best, best_score = nid, score
         return best, False

@@ -141,11 +141,16 @@ class WholeOut:
     carries the host-assembly facts the finalizers need (per-group
     shard lists, fragment-less shards, actual batch rows)."""
 
-    __slots__ = ("parts", "meta")
+    __slots__ = ("parts", "meta", "sig")
 
-    def __init__(self, parts, meta):
+    def __init__(self, parts, meta, sig: str | None = None):
         self.parts = parts
         self.meta = meta
+        # compiled program signature (devobs.sig_of of the executable
+        # cache key — the SAME id the compile registry and launch ledger
+        # record), surfaced on the request thread for the EXPLAIN plan
+        # section; None for the no-live-groups empty launch
+        self.sig = sig
 
     def slice_batch(self, program, node_lo: list[int], node_b: list[int]):
         """A fused launch's per-ticket view: slice every node's batch
@@ -161,7 +166,7 @@ class WholeOut:
             else:
                 parts.append([arr[lo:lo + b] for arr in self.parts[ni]])
             meta.append(m)
-        return WholeOut(parts, meta)
+        return WholeOut(parts, meta, self.sig)
 
 
 class _InstrumentedWhole:
@@ -208,8 +213,14 @@ class _InstrumentedWhole:
             slice_pos=_devobs.current_slice())
         prof = qprof.current()
         if prof is not None:
+            # rows/padding/decode tags feed the EXPLAIN launches section
+            # (utils/explain.py), mirroring the ledger entry
             prof.event("device.launch", dt, kind="wholequery",
                        sig=self.sig, shards=m.get("shards", 0),
+                       shardsPadded=m.get("shards_padded", 0),
+                       batchRows=rows,
+                       batchRowsPadded=m.get("rows_padded", 1),
+                       decodeBytes=m.get("decode_bytes", 0),
                        compiled=compiled)
         return out
 
@@ -384,7 +395,7 @@ class WholeQueryRunner:
         meta = self._node_meta(program, actual_b, live, sched,
                                empty_shards)
         if not live:
-            return WholeOut([[] for _ in program], meta)
+            return WholeOut([[] for _ in program], meta)  # no launch
 
         # The shard-bucket (stacked leading dim) is deliberately NOT in
         # the key: like every mesh executable, a bucket change re-traces
@@ -420,7 +431,7 @@ class WholeQueryRunner:
         with _DISPATCH_LOCK:
             flat_out = fn(mats_dev, *flat_all, _launch_meta=launch_meta)
         parts = [[flat_out[j] for j in idxs] for idxs in fn.out_index]
-        return WholeOut(parts, meta)
+        return WholeOut(parts, meta, fn.sig)
 
     def _node_meta(self, program, actual_b, live, sched, empty_shards):
         meta = []

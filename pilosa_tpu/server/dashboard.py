@@ -34,7 +34,9 @@ DASHBOARD_HTML = """<!doctype html>
 </style>
 </head>
 <body>
-<h1>pilosa-tpu &middot; device runtime</h1>
+<h1>pilosa-tpu &middot; device runtime
+  <a href="/debug/dashboard/cluster" style="font-size:11px;
+     color:#7aa2f7; margin-left:10px">fleet view &rarr;</a></h1>
 <div id="meta">loading&hellip;</div>
 <div id="grid"></div>
 <script>
@@ -67,6 +69,14 @@ const CHARTS = [
        return t ? 100 * s.rowsPaddedDelta / t : 0; }}]},
   {title: "decode workspace peak", unit: "MB",
    series: [{label: "peak", f: s => MB(s.decodePeakBytes)}]},
+  {title: "cluster health", unit: "/interval",
+   series: [{label: "hedges", f: s => s.hedgesDelta},
+            {label: "retry waves", f: s => s.retryWavesDelta},
+            {label: "partial", f: s => s.partialResultsDelta},
+            {label: "route fallback", f: s => s.routingFallbacksDelta},
+            {label: "handoffs", f: s => s.balancerHandoffsDelta}]},
+  {title: "fleet events", unit: "/interval",
+   series: [{label: "events", f: s => s.fleetEventsDelta}]},
 ];
 function fmt(v) {
   if (!isFinite(v)) return "-";
@@ -129,6 +139,119 @@ async function tick() {
     ]);
     render(ts, vars);
     setTimeout(tick, Math.max((ts.intervalS || 5) * 1000, 1000));
+  } catch (e) {
+    document.getElementById("meta").innerHTML =
+      `<span class="err">fetch failed: ${e}</span>`;
+    setTimeout(tick, 5000);
+  }
+}
+tick();
+</script>
+</body>
+</html>
+"""
+
+# /debug/dashboard/cluster: the fleet page (docs/observability.md
+# "Cluster plane") — a per-node table of the rollup summaries (stale
+# nodes dimmed and flagged) plus the merged event timeline, polled from
+# /debug/cluster on its TTL cadence.  Same zero-dependency discipline
+# as the node page.
+CLUSTER_DASHBOARD_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>pilosa-tpu fleet</title>
+<style>
+  :root { color-scheme: dark; }
+  body { margin: 0; padding: 16px 20px; background: #14161a;
+         color: #d6d9de; font: 13px/1.45 system-ui, sans-serif; }
+  h1 { font-size: 15px; margin: 0 0 2px; font-weight: 600; }
+  h2 { font-size: 13px; margin: 18px 0 6px; font-weight: 600;
+       color: #aab0b9; }
+  #meta { color: #8a8f98; margin-bottom: 14px; }
+  table { border-collapse: collapse; width: 100%;
+          font-variant-numeric: tabular-nums; }
+  th, td { text-align: right; padding: 3px 10px;
+           border-bottom: 1px solid #262a31; font-size: 12px; }
+  th { color: #8a8f98; font-weight: 500; }
+  th:first-child, td:first-child { text-align: left; }
+  tr.stale td { color: #6b7077; }
+  .down { color: #f7768e; }
+  .flag { color: #e0af68; }
+  #timeline { font: 11px/1.6 ui-monospace, monospace; color: #aab0b9;
+              max-height: 320px; overflow-y: auto; background: #1b1e24;
+              border: 1px solid #262a31; border-radius: 6px;
+              padding: 8px 12px; }
+  .ev { color: #7aa2f7; }
+  .err { color: #e07a5f; }
+</style>
+</head>
+<body>
+<h1>pilosa-tpu &middot; fleet
+  <a href="/debug/dashboard" style="font-size:11px; color:#7aa2f7;
+     margin-left:10px">&larr; node view</a></h1>
+<div id="meta">loading&hellip;</div>
+<h2>nodes</h2>
+<table id="nodes"><thead><tr>
+  <th>node</th><th>state</th><th>qps</th><th>p99 ms</th>
+  <th>HBM MB</th><th>evict</th><th>retrace</th><th>hedges</th>
+  <th>waves</th><th>partial</th><th>quar</th><th>ingest MB</th>
+  <th>stale s</th>
+</tr></thead><tbody></tbody></table>
+<h2>fleet timeline</h2>
+<div id="timeline"></div>
+<script>
+"use strict";
+const MB = b => (b / 1048576).toFixed(0);
+function render(c) {
+  const nodes = c.nodes || {};
+  document.getElementById("meta").textContent =
+    `coordinator ${c.coordinator} · epoch ${c.epoch} · ` +
+    `overlay ${c.overlayEpoch} · refreshes ${c.refreshes} · ` +
+    `fetch errors ${c.fetchErrors}`;
+  const tb = document.querySelector("#nodes tbody");
+  tb.innerHTML = "";
+  for (const nid of Object.keys(nodes).sort()) {
+    const n = nodes[nid];
+    const tr = document.createElement("tr");
+    if (n.stale) tr.className = "stale";
+    const cells = [
+      nid,
+      n.state === "READY" ? "READY" :
+        `<span class="down">${n.state}</span>`,
+      (n.qps ?? 0).toFixed(1),
+      n.p99Ms ?? "-",
+      MB(n.hbmResidentBytes || 0),
+      n.evictions ?? "-",
+      n.retraces ?? "-",
+      `${n.hedges ?? "-"}/${n.hedgeWins ?? "-"}`,
+      n.retryWaves ?? "-",
+      n.partialResults ?? "-",
+      n.quarantinedFragments ?
+        `<span class="flag">${n.quarantinedFragments}</span>` : 0,
+      MB(n.ingestBacklogBytes || 0),
+      n.stale ? `<span class="flag">${
+        n.staleS != null ? n.staleS.toFixed(0) : "?"}</span>` : "",
+    ];
+    tr.innerHTML = cells.map(x => `<td>${x}</td>`).join("");
+    tb.appendChild(tr);
+  }
+  const tl = document.getElementById("timeline");
+  tl.innerHTML = (c.timeline || []).slice(-200).reverse().map(e => {
+    const when = e.wall ?
+      new Date(e.wall * 1000).toISOString().slice(11, 19) : "-";
+    const rest = Object.entries(e).filter(
+      ([k]) => !["event", "node", "wall", "seq"].includes(k))
+      .map(([k, v]) => `${k}=${JSON.stringify(v)}`).join(" ");
+    return `${when} <b>${e.node || "?"}</b> ` +
+      `<span class="ev">${e.event}</span> ${rest}`;
+  }).join("<br>") || "no events yet";
+}
+async function tick() {
+  try {
+    const c = await fetch("/debug/cluster").then(r => r.json());
+    render(c);
+    setTimeout(tick, Math.max((c.ttlS || 2) * 1000, 1000));
   } catch (e) {
     document.getElementById("meta").innerHTML =
       `<span class="err">fetch failed: ${e}</span>`;

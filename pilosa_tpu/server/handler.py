@@ -23,6 +23,7 @@ from .. import __version__
 from ..api import API, ApiError, ConflictError, DisallowedError, NotFoundError
 from ..storage.fragment import FragmentQuarantinedError
 from ..utils import degraded
+from ..utils import explain as qexplain
 from ..utils.locks import make_lock
 from ..utils import profile as qprof
 from ..utils.deadline import (DEADLINE_HEADER, DeadlineExceeded,
@@ -113,6 +114,126 @@ class Router:
                     return fn, mt.groupdict(), gate, stream
         return ("method_not_allowed" if found_path else None), {}, \
             None, False
+
+
+def build_debug_vars(api: API, server=None) -> dict:
+    """The /debug/vars snapshot body — module-level so the fleet rollup
+    (parallel/rollup.py) builds the LOCAL node's summary from exactly
+    the surface peers serve over the wire (golden agreement between
+    /debug/cluster and per-node /debug/vars is by construction)."""
+    from ..storage.membudget import DEFAULT_BUDGET, HOST_STAGE_BUDGET
+    out = api.stats.snapshot()
+    # deviceBudget carries the streaming-pipeline counters too:
+    # uploadBytes / prefetchHits / prefetchMisses / pinnedBytes
+    out["deviceBudget"] = DEFAULT_BUDGET.stats()
+    out["hostStage"] = HOST_STAGE_BUDGET.stats()
+    ex = api.executor
+    if ex.result_cache is not None:
+        out["resultCache"] = ex.result_cache.snapshot()
+    if ex.prepared is not None:
+        out["preparedCache"] = {
+            "entries": len(ex.prepared._entries),
+            "hits": ex.prepared.hits,
+            "misses": ex.prepared.misses,
+            "guardMisses": ex.prepared.guard_misses,
+        }
+    if ex.mesh_exec is not None:
+        out["stackCache"] = {
+            "entries": len(ex.mesh_exec._stack_cache),
+            "executables": len(ex.mesh_exec._cache),
+        }
+    # cross-query dynamic batching (docs/batching.md): fused/single
+    # launch counters, the batch-size histogram, and the queue-wait
+    # p50/p99 — the knobs' feedback loop for tuning window/max
+    if ex.batcher is not None:
+        out["dispatchBatcher"] = ex.batcher.snapshot()
+    # whole-query pjit programs (docs/whole-query.md): requests
+    # served as one program vs fallbacks to the legacy per-stage
+    # path, with the last fallback's unsupported-node name
+    if ex.wholequery is not None:
+        out["wholeQuery"] = {
+            "enabled": ex.whole_query,
+            "requests": ex.wq_requests,
+            "fallbacks": ex.wq_fallbacks,
+            "lastFallback": ex.wq_last_fallback,
+        }
+    # overload armor: slot/queue state, per-peer breaker state, armed
+    # failpoints (docs/robustness.md); deadline-abort and admission
+    # rejection COUNTERS live in "counts" via the stats client
+    if server is not None and getattr(server, "admission",
+                                      None) is not None:
+        out["admission"] = {
+            "public": server.admission.snapshot(),
+            "internal": server.admission_internal.snapshot(),
+        }
+    if server is not None and getattr(server, "cluster",
+                                      None) is not None:
+        out["breakers"] = server.cluster.client.breaker_snapshot()
+        # elastic serving (docs/cluster.md "Read routing &
+        # rebalancing"): per-peer routing state (EWMA RTT, in-flight,
+        # residency summary age, breaker state), the placement
+        # overlay, and the balancer's hot-shard view
+        cl = server.cluster
+        out["cluster"] = {
+            "routing": cl.router.snapshot(),
+            "overlay": cl.overlay_snapshot(),
+            "balancer": cl.balancer.snapshot(),
+        }
+    from ..utils.faults import FAULTS
+    armed = FAULTS.snapshot()
+    if armed:
+        out["failpoints"] = armed
+    slog = getattr(server, "slowlog", None) if server is not None \
+        else None
+    if slog is not None:
+        out["slowLog"] = {"thresholdS": slog.threshold_s,
+                          "size": slog.size,
+                          "textMax": slog.text_max,
+                          "recorded": slog.recorded}
+    # event journal (docs/observability.md "Cluster plane"): counters
+    # only — the timeline itself is /debug/events
+    from ..utils.events import EVENTS
+    out["events"] = {"seq": EVENTS.last_seq(), "emitted": EVENTS.emitted,
+                     "writeErrors": EVENTS.write_errors}
+    # durability & recovery (docs/robustness.md): quarantine state,
+    # torn-tail/repair event counters, anti-entropy health
+    from ..storage.fragment import storage_events
+    container_stats = api.holder.container_stats()
+    out["storage"] = {
+        "events": storage_events(),
+        "quarantined": api.holder.quarantined_fragments(),
+        "corruptAttrStores": api.holder.corrupt_attr_stores(),
+        # compressed residency (docs/memory-budget.md): per-holder
+        # container-type histogram + device-form census; the
+        # compressed/dense byte split rides deviceBudget above
+        "containers": container_stats,
+    }
+    if server is not None:
+        server.update_storage_gauges(container_stats=container_stats)
+        if getattr(server, "cluster", None) is not None:
+            out["storage"]["antiEntropy"] = server.cluster.ae_snapshot()
+    # device runtime (docs/observability.md "Device runtime"):
+    # compile-registry + launch-ledger aggregates and the
+    # time-series summary; full detail at /debug/compiles,
+    # /debug/launches, /debug/timeseries
+    from ..utils import devobs
+    out["device"] = {"compiles": devobs.COMPILES.totals(),
+                     "launches": devobs.LEDGER.aggregates()}
+    # streaming ingest (docs/ingest.md): group-commit backlog, flush
+    # counters, and the delta-overlay journal footprint
+    committer = getattr(server, "committer", None) \
+        if server is not None else None
+    if committer is not None:
+        out["ingest"] = committer.snapshot()
+    ts = getattr(server, "timeseries", None) if server is not None \
+        else None
+    if ts is not None:
+        snap_ts = ts.snapshot()
+        out["timeseries"] = {
+            k: snap_ts[k] for k in ("intervalS", "windowS",
+                                    "capacity", "samplesTotal",
+                                    "coveredS")}
+    return out
 
 
 def build_router(api: API, server=None) -> Router:
@@ -462,121 +583,28 @@ def build_router(api: API, server=None) -> Router:
     # -- observability (handler.go:280-282) -------------------------------
     def debug_vars(req, args):
         """expvar-style snapshot: stats + HBM budget + query-cache state,
-        so perf work can attribute latency to phases (r3 verdict #10)."""
-        from ..storage.membudget import DEFAULT_BUDGET, HOST_STAGE_BUDGET
-        out = api.stats.snapshot()
-        # deviceBudget carries the streaming-pipeline counters too:
-        # uploadBytes / prefetchHits / prefetchMisses / pinnedBytes
-        out["deviceBudget"] = DEFAULT_BUDGET.stats()
-        out["hostStage"] = HOST_STAGE_BUDGET.stats()
-        ex = api.executor
-        if ex.result_cache is not None:
-            out["resultCache"] = ex.result_cache.snapshot()
-        if ex.prepared is not None:
-            out["preparedCache"] = {
-                "entries": len(ex.prepared._entries),
-                "hits": ex.prepared.hits,
-                "misses": ex.prepared.misses,
-                "guardMisses": ex.prepared.guard_misses,
-            }
-        if ex.mesh_exec is not None:
-            out["stackCache"] = {
-                "entries": len(ex.mesh_exec._stack_cache),
-                "executables": len(ex.mesh_exec._cache),
-            }
-        # cross-query dynamic batching (docs/batching.md): fused/single
-        # launch counters, the batch-size histogram, and the queue-wait
-        # p50/p99 — the knobs' feedback loop for tuning window/max
-        if ex.batcher is not None:
-            out["dispatchBatcher"] = ex.batcher.snapshot()
-        # whole-query pjit programs (docs/whole-query.md): requests
-        # served as one program vs fallbacks to the legacy per-stage
-        # path, with the last fallback's unsupported-node name
-        if ex.wholequery is not None:
-            out["wholeQuery"] = {
-                "enabled": ex.whole_query,
-                "requests": ex.wq_requests,
-                "fallbacks": ex.wq_fallbacks,
-                "lastFallback": ex.wq_last_fallback,
-            }
-        # overload armor: slot/queue state, per-peer breaker state, armed
-        # failpoints (docs/robustness.md); deadline-abort and admission
-        # rejection COUNTERS live in "counts" via the stats client
-        if server is not None and getattr(server, "admission",
-                                          None) is not None:
-            out["admission"] = {
-                "public": server.admission.snapshot(),
-                "internal": server.admission_internal.snapshot(),
-            }
-        if server is not None and getattr(server, "cluster",
-                                          None) is not None:
-            out["breakers"] = server.cluster.client.breaker_snapshot()
-            # elastic serving (docs/cluster.md "Read routing &
-            # rebalancing"): per-peer routing state (EWMA RTT, in-flight,
-            # residency summary age, breaker state), the placement
-            # overlay, and the balancer's hot-shard view
-            cl = server.cluster
-            out["cluster"] = {
-                "routing": cl.router.snapshot(),
-                "overlay": cl.overlay_snapshot(),
-                "balancer": cl.balancer.snapshot(),
-            }
-        from ..utils.faults import FAULTS
-        armed = FAULTS.snapshot()
-        if armed:
-            out["failpoints"] = armed
-        slog = getattr(server, "slowlog", None) if server is not None \
-            else None
-        if slog is not None:
-            out["slowLog"] = {"thresholdS": slog.threshold_s,
-                              "size": slog.size,
-                              "recorded": slog.recorded}
-        # durability & recovery (docs/robustness.md): quarantine state,
-        # torn-tail/repair event counters, anti-entropy health
-        from ..storage.fragment import storage_events
-        container_stats = api.holder.container_stats()
-        out["storage"] = {
-            "events": storage_events(),
-            "quarantined": api.holder.quarantined_fragments(),
-            "corruptAttrStores": api.holder.corrupt_attr_stores(),
-            # compressed residency (docs/memory-budget.md): per-holder
-            # container-type histogram + device-form census; the
-            # compressed/dense byte split rides deviceBudget above
-            "containers": container_stats,
-        }
-        if server is not None:
-            server.update_storage_gauges(container_stats=container_stats)
-            if getattr(server, "cluster", None) is not None:
-                out["storage"]["antiEntropy"] = server.cluster.ae_snapshot()
-        # device runtime (docs/observability.md "Device runtime"):
-        # compile-registry + launch-ledger aggregates and the
-        # time-series summary; full detail at /debug/compiles,
-        # /debug/launches, /debug/timeseries
-        from ..utils import devobs
-        out["device"] = {"compiles": devobs.COMPILES.totals(),
-                         "launches": devobs.LEDGER.aggregates()}
-        # streaming ingest (docs/ingest.md): group-commit backlog, flush
-        # counters, and the delta-overlay journal footprint
-        committer = getattr(server, "committer", None) \
-            if server is not None else None
-        if committer is not None:
-            out["ingest"] = committer.snapshot()
-        ts = getattr(server, "timeseries", None) if server is not None \
-            else None
-        if ts is not None:
-            snap_ts = ts.snapshot()
-            out["timeseries"] = {
-                k: snap_ts[k] for k in ("intervalS", "windowS",
-                                        "capacity", "samplesTotal",
-                                        "coveredS")}
-        return out
+        so perf work can attribute latency to phases (r3 verdict #10).
+        Body shared with the fleet rollup's local-node path
+        (build_debug_vars) so /debug/cluster agrees with this surface by
+        construction."""
+        return build_debug_vars(api, server)
 
     def metrics(req, args):
         if server is not None:
             # refresh the storage.* + device.* gauges so scrapes see
             # current values
             server.update_storage_gauges()
-        text = api.stats.prometheus_text()
+        # trace-id exemplars are OpenMetrics-only syntax: a classic
+        # 0.0.4 parser rejects the `# {...}` suffix and the whole
+        # scrape goes dark.  They attach ONLY on the explicit
+        # `?exemplars=true` opt-in (docs/observability.md "Trace
+        # exemplars") — deliberately NOT Accept-header negotiation:
+        # stock Prometheus advertises application/openmetrics-text by
+        # default, and answering it with this exposition (whose counter
+        # names predate the OpenMetrics `_total` rule) would break the
+        # default scrape that works today.
+        exemplars = req.query.get("exemplars", [""])[0] == "true"
+        text = api.stats.prometheus_text(exemplars=exemplars)
         # the batcher's and launch ledger's histogram/summary series
         # don't fit the stats client's counter/gauge model; they export
         # their own lines
@@ -584,6 +612,21 @@ def build_router(api: API, server=None) -> Router:
             text += api.executor.batcher.prometheus_text()
         from ..utils import devobs
         text += devobs.LEDGER.prometheus_text()
+        # fleet rollup (docs/observability.md "Cluster plane"): the
+        # pilosa_tpu_cluster_* family with node labels.  Exported by
+        # the COORDINATOR's scrape only — every node exporting it would
+        # ingest each series N times and turn a scrape-all-nodes setup
+        # into N*(N-1) peer pulls per interval.  refresh() is
+        # TTL-cached and never blocks on a dead peer, so the scrape
+        # stays bounded.
+        rollup = getattr(server, "rollup", None) if server is not None \
+            else None
+        if rollup is not None and server.cluster.is_coordinator:
+            rollup.refresh()
+            text += rollup.prometheus_text()
+        if exemplars:
+            return ("application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8", text + "# EOF\n")
         return ("text/plain; version=0.0.4", text)
 
     if api.stats is not None:
@@ -591,11 +634,87 @@ def build_router(api: API, server=None) -> Router:
         r.add("GET", "/debug/vars", debug_vars)
 
     def debug_traces(req, args):
+        """Span ring (bounded retention).  ``?trace=<id>`` returns one
+        trace's spans; ``?index=`` / ``?minMs=`` / ``?status=`` search
+        ROOT spans and return trace summaries — the drill-down behind a
+        histogram exemplar (docs/observability.md "Trace exemplars")."""
         from ..utils.tracing import GLOBAL_TRACER
         tid = req.query.get("trace", [None])[0]
-        return {"spans": GLOBAL_TRACER.spans(tid)}
+        if tid is not None:
+            return {"spans": GLOBAL_TRACER.spans(tid)}
+        index = req.query.get("index", [None])[0]
+        min_ms = req.query.get("minMs", [None])[0]
+        status_q = req.query.get("status", [None])[0]
+        if index is not None or min_ms is not None \
+                or status_q is not None:
+            try:
+                min_s = float(min_ms) / 1e3 if min_ms is not None \
+                    else None
+                status_i = int(status_q) if status_q is not None else None
+            except (TypeError, ValueError):
+                raise ApiError("minMs/status must be numbers")
+            return {"traces": GLOBAL_TRACER.search(
+                index=index, min_duration_s=min_s, status=status_i)}
+        return {"spans": GLOBAL_TRACER.spans(None)}
 
     r.add("GET", "/debug/traces", debug_traces)
+
+    def debug_events(req, args):
+        """Event journal (utils/events.py): ``?since=<seq>`` returns
+        only newer events — the cursor the fleet rollup merges per-node
+        journals with."""
+        from ..utils.events import EVENTS
+        since = req.query.get("since", [None])[0]
+        limit = req.query.get("limit", [None])[0]
+        try:
+            since_i = int(since) if since is not None else None
+            limit_i = int(limit) if limit is not None else None
+        except (TypeError, ValueError):
+            raise ApiError("since/limit must be integers")
+        if since_i is None:
+            out = EVENTS.snapshot()
+            if limit_i is not None:
+                # newest entries for the no-cursor browse form (the
+                # cursor form below keeps oldest); guard limit=0 — a
+                # [-0:] slice would return everything
+                out["events"] = out["events"][-limit_i:] \
+                    if limit_i > 0 else []
+            return out
+        return {"seq": EVENTS.last_seq(),
+                "events": EVENTS.since(since_i, limit=limit_i)}
+
+    r.add("GET", "/debug/events", debug_events)
+
+    def debug_cluster(req, args):
+        """Fleet rollup (docs/observability.md "Cluster plane"):
+        per-node summaries with staleness stamps + the merged event
+        timeline.  Single-node servers answer with their own summary so
+        dashboards work unchanged."""
+        rollup = getattr(server, "rollup", None) if server is not None \
+            else None
+        if rollup is None:
+            from ..parallel.rollup import summarize_vars
+            info = {"state": "READY", "stale": False,
+                    "qps": 0.0}
+            info.update(summarize_vars(build_debug_vars(api, server)))
+            from ..utils.events import EVENTS
+            from ..parallel.rollup import FleetRollup
+            # same top-level keys FleetRollup.snapshot() emits: the
+            # fleet dashboard renders refreshes/fetchErrors/ttlS
+            # unconditionally, and "dashboards work unchanged" is this
+            # fallback's whole point
+            # lint: allow(wall-clock) — display-only snapshot stamp,
+            # never subtracted (mirrors FleetRollup._wall_stamp)
+            return {"wall": time.time(), "ttlS": FleetRollup.TTL_S,
+                    "refreshes": 0, "fetchErrors": 0,
+                    "coordinator": "local", "overlayEpoch": 0,
+                    "epoch": 0, "nodes": {"local": info},
+                    "timeline": EVENTS.since(0), "hotShards": {}}
+        rollup.refresh(
+            force=req.query.get("refresh", [""])[0] == "true")
+        return rollup.snapshot()
+
+    r.add("GET", "/debug/cluster", debug_cluster)
 
     def debug_slow(req, args):
         """Slow-query log ring (docs/observability.md): queries that ran
@@ -647,6 +766,14 @@ def build_router(api: API, server=None) -> Router:
         return ("text/html; charset=utf-8", DASHBOARD_HTML)
 
     r.add("GET", "/debug/dashboard", debug_dashboard)
+
+    def debug_dashboard_cluster(req, args):
+        """Fleet page: per-node table + merged timeline rendered from
+        /debug/cluster (docs/observability.md "Cluster plane")."""
+        from .dashboard import CLUSTER_DASHBOARD_HTML
+        return ("text/html; charset=utf-8", CLUSTER_DASHBOARD_HTML)
+
+    r.add("GET", "/debug/dashboard/cluster", debug_dashboard_cluster)
 
     def debug_locks(req, args):
         """Lock-order race detector dump (docs/static-analysis.md):
@@ -863,7 +990,9 @@ class _HandlerClass(BaseHTTPRequestHandler):
         ctx = None
         status = 200
         prof = None
+        erec = None
         want_profile = False
+        want_explain = False
         trace_out = None
         t_req0 = time.perf_counter()
         try:
@@ -900,9 +1029,20 @@ class _HandlerClass(BaseHTTPRequestHandler):
             if gate == "query":
                 want_profile = (self._query.get("profile", [""])[0]
                                 == "true" or self.profile_default)
-                if want_profile or (self.slowlog is not None
-                                    and self.slowlog.enabled):
+                # EXPLAIN (utils/explain.py): the decision record rides
+                # the same collection discipline as the profile —
+                # assembled when the client asked (?explain=true) OR
+                # silently for slow-log entries; embedded only when
+                # requested.  Explain implies profile collection: the
+                # launches section reads the profile tree.
+                want_explain = self._query.get("explain", [""])[0] \
+                    == "true"
+                slow_on = (self.slowlog is not None
+                           and self.slowlog.enabled)
+                if want_profile or want_explain or slow_on:
                     prof = qprof.QueryProfile()
+                if want_explain or slow_on:
+                    erec = qexplain.ExplainRecord()
             adm = self.admission if gate == "query" else \
                 self.admission_internal if gate == "internal" else \
                 self.admission_ingest if gate == "ingest" else None
@@ -934,10 +1074,15 @@ class _HandlerClass(BaseHTTPRequestHandler):
                             f"{method} {parsed.path}", trace_id=tid,
                             parent_id=parent_id, sampled=root_sampled,
                             collect=collect) as span, \
-                            qprof.activate(prof):
+                            qprof.activate(prof), \
+                            qexplain.activate(erec):
                         self._trace_span = span
                         self._span_collect = collect
                         trace_out = span.trace_id
+                        if "index" in args:
+                            # searchable root-span tags: /debug/traces
+                            # ?index=... filters on them
+                            span.set_tag("index", args["index"])
                         out = fn(self, args)
             finally:
                 if admitted:
@@ -957,6 +1102,14 @@ class _HandlerClass(BaseHTTPRequestHandler):
                     out = dict(out)
                     out["traceID"] = trace_out
                     out["profile"] = prof.to_dict()
+                if want_explain and erec is not None:
+                    # the record rides the response ENVELOPE: results
+                    # stay byte-identical with explain on
+                    erec.set_info("traceID", trace_out)
+                    out = dict(out)
+                    out["explain"] = erec.to_dict(
+                        profile=prof.to_dict() if prof is not None
+                        else None)
                 self._send(200, out, headers=resp_headers)
         except AdmissionRejected as e:
             # overload/drain rejection: bounded, explicit, retryable
@@ -1002,20 +1155,31 @@ class _HandlerClass(BaseHTTPRequestHandler):
             self._send(500, {"error": f"internal error: {e}"})
         finally:
             self._observe(gate, args, time.perf_counter() - t_req0,
-                          status, background, prof, trace_out)
+                          status, background, prof, erec, trace_out)
 
     def _observe(self, gate, args, dur_s, status, background, prof,
-                 trace_id):
+                 erec, trace_id):
         """Post-request accounting (docs/observability.md): latency
-        histograms + the slow-query log.  Background traffic (probes,
+        histograms (with the trace id attached as the landing bucket's
+        exemplar) + the slow-query log.  Background traffic (probes,
         status/metrics/debug) was tagged by the caller and is excluded
         from both."""
+        # status stamped post-finish onto the root span: the ring holds
+        # Span objects and renders tags lazily, so /debug/traces search
+        # by status sees it
+        sp = getattr(self, "_trace_span", None)
+        if sp is not None and trace_id is not None:
+            sp.tags["status"] = status
         if background:
             return
+        # exemplars must RESOLVE at /debug/traces — only sampled traces
+        # qualify (docs/observability.md "Trace exemplars")
+        exemplar = trace_id if (sp is not None and sp.sampled
+                                and trace_id is not None) else None
         if self.stats is not None:
-            self.stats.timing("http.request", dur_s)
+            self.stats.timing("http.request", dur_s, exemplar=exemplar)
             if gate == "query":
-                self.stats.timing("http.query", dur_s)
+                self.stats.timing("http.query", dur_s, exemplar=exemplar)
         slog = self.slowlog
         if (gate == "query" and slog is not None and slog.enabled
                 and dur_s >= slog.threshold_s):
@@ -1027,7 +1191,9 @@ class _HandlerClass(BaseHTTPRequestHandler):
             slog.record(index=args.get("index", ""),
                         query=self.body.decode("utf-8", "replace"),
                         duration_s=dur_s, shards=shards,
-                        trace_id=trace_id, status=status, profile=profile)
+                        trace_id=trace_id, status=status, profile=profile,
+                        explain=erec.to_dict(profile=profile)
+                        if erec is not None else None)
 
     def _send(self, code: int, obj, headers: dict | None = None):
         self._send_raw(code, "application/json",

@@ -231,6 +231,26 @@ class Config:
     timeseries_window: float = 600.0
     # Entries kept in the device launch-ledger ring (/debug/launches).
     launch_ledger_size: int = 256
+    # -- cluster observability plane (docs/observability.md) ---------------
+    # Entries kept in the structured event-journal ring (/debug/events):
+    # breaker/node/quarantine/overlay/resize/backpressure transitions.
+    event_journal_size: int = 512
+    # Persist the event journal to <data-dir>/events.log as length+CRC
+    # framed JSON records (torn tails truncate at a frame boundary on
+    # reopen).  Off keeps the journal in-memory only.
+    event_log: bool = False
+    # Characters of query text stored per slow-log entry.  Raise it when
+    # harvesting a recorded workload for replay (bench.py): entries
+    # still over the ceiling are marked textTruncated and skipped by the
+    # replay harvester.
+    slow_log_text_max: int = 512
+    # Per-launch batch-temp workspace ceiling (MB) for fused/batched
+    # [B, rows, W] device temps (row_counts/TopN batches): the batch
+    # axis chunks when a launch would exceed it (counted
+    # query.batch_temp_splits), and the cross-query batcher stops
+    # fusing past it.  The decode-workspace-mb pattern, on the batch
+    # axis.
+    batch_temp_mb: int = 4096
     verbose: bool = False
 
     @classmethod
@@ -325,6 +345,10 @@ class Config:
                                                float),
             "PILOSA_TPU_TIMESERIES_WINDOW": ("timeseries_window", float),
             "PILOSA_TPU_LAUNCH_LEDGER_SIZE": ("launch_ledger_size", int),
+            "PILOSA_TPU_EVENT_JOURNAL_SIZE": ("event_journal_size", int),
+            "PILOSA_TPU_EVENT_LOG": ("event_log", lambda s: s == "true"),
+            "PILOSA_TPU_SLOW_LOG_TEXT_MAX": ("slow_log_text_max", int),
+            "PILOSA_TPU_BATCH_TEMP_MB": ("batch_temp_mb", int),
         }
         for env, (attr, conv) in env_map.items():
             if env in os.environ:
@@ -389,6 +413,10 @@ class Config:
             "timeseries-interval": "timeseries_interval",
             "timeseries-window": "timeseries_window",
             "launch-ledger-size": "launch_ledger_size",
+            "event-journal-size": "event_journal_size",
+            "event-log": "event_log",
+            "slow-log-text-max": "slow_log_text_max",
+            "batch-temp-mb": "batch_temp_mb",
         }
         for key, attr in mapping.items():
             if key in doc:
@@ -447,6 +475,12 @@ class Server:
         from ..parallel import mesh_exec as _mesh_exec
         _mesh_exec.DECODE_WORKSPACE_BYTES = \
             max(self.config.decode_workspace_mb, 1) << 20
+        # batch-temp workspace (docs/observability.md satellite of the
+        # decode-workspace pattern): bounds fused/batched [B, rows, W]
+        # device temps; process-wide, most recent Server wins
+        from ..executor import executor as _executor_mod
+        _executor_mod.BATCH_TEMP_BYTES = \
+            max(self.config.batch_temp_mb, 1) << 20
         # streaming ingest (docs/ingest.md): the delta-overlay budget is
         # process-wide like the others (most recent Server wins)
         from ..storage import membudget as _membudget
@@ -553,7 +587,20 @@ class Server:
         self.slowlog = SlowQueryLog(
             threshold_s=self.config.slow_query_threshold,
             size=self.config.slow_log_size,
-            logger=self.logger, stats=self.stats)
+            logger=self.logger, stats=self.stats,
+            text_max=self.config.slow_log_text_max)
+        # Event journal (docs/observability.md "Cluster plane"):
+        # process-wide like the tracer — the most recent Server's config
+        # sizes the ring, stamps the node id, and (opt-in) attaches the
+        # framed on-disk log under the data dir.
+        from ..utils.events import EVENTS
+        EVENTS.resize(self.config.event_journal_size)
+        EVENTS.node_id = self.config.node_id
+        if self.config.event_log:
+            # the holder creates data_dir at open(); the journal
+            # attaches earlier, so ensure the directory here
+            os.makedirs(data_dir, exist_ok=True)
+            EVENTS.open_log(os.path.join(data_dir, "events.log"))
         # Device-runtime observability (docs/observability.md "Device
         # runtime"): the process-wide compile registry logs retraces
         # through THIS server's logger (most recent Server wins, like
@@ -583,6 +630,19 @@ class Server:
             partial_results=self.config.partial_results,
             slowlog=self.slowlog,
             profile_default=self.config.profile_default)
+        # Fleet rollup (docs/observability.md "Cluster plane"): any
+        # clustered node can aggregate its peers' /debug/vars + event
+        # journals into /debug/cluster and the pilosa_tpu_cluster_*
+        # family; the local node's summary is built from the SAME
+        # build_debug_vars body peers serve over the wire.
+        self.rollup = None
+        if self.cluster is not None:
+            from ..parallel.rollup import FleetRollup
+            from .handler import build_debug_vars
+            self.rollup = FleetRollup(
+                self.cluster,
+                local_vars_fn=lambda: build_debug_vars(self.api, self),
+                stats=self.stats)
         from ..utils.diagnostics import DiagnosticsCollector
         self.diagnostics = DiagnosticsCollector(
             self, self.config.diagnostics_endpoint,
@@ -710,6 +770,7 @@ class Server:
         from ..parallel import mesh_exec as _mesh_exec
         from ..storage.membudget import DEFAULT_BUDGET, HOST_STAGE_BUDGET
         from ..utils import devobs
+        from ..utils import events as _events_mod
         b = DEFAULT_BUDGET.stats()
         req_count, _ = self.stats.timing_totals("http.request")
         q_count, q_sum = self.stats.timing_totals("http.query")
@@ -730,6 +791,24 @@ class Server:
             "rowsActual": led["rowsActual"],
             "rowsPadded": led["rowsPadded"],
         }
+        # cluster-health motion (docs/observability.md "Cluster plane"):
+        # per-interval deltas of the PR 13/14 cluster counters so the
+        # dashboard timeline shows routing/hedging/partial churn, not
+        # just device churn.  Zero-valued on single-node servers.
+        counters.update({
+            "hedges": self.stats.count_value("cluster.hedges"),
+            "hedgeWins": self.stats.count_value("cluster.hedge_wins"),
+            "retryWaves": self.stats.count_value("cluster.retry_waves"),
+            "partialResults": self.stats.count_value(
+                "cluster.partial_results"),
+            "routingFallbacks": self.stats.count_value(
+                "routing.fallback"),
+            "breakerSkips": self.stats.count_value(
+                "routing.breaker_skip"),
+            "balancerHandoffs": self.cluster.balancer.handoffs
+            if self.cluster is not None else 0,
+            "fleetEvents": _events_mod.EVENTS.last_seq(),
+        })
         # The counter sources are process-wide singletons that predate
         # this Server: the first sample has no previous snapshot, and
         # reporting lifetime totals as "this interval's delta" would
@@ -889,6 +968,8 @@ class Server:
         calls it first."""
         if timeout is None:
             timeout = self.config.drain_seconds
+        from ..utils import events
+        events.emit("server.drain", budgetS=round(max(timeout, 0.0), 3))
         self.admission.begin_drain()
         drained = self.admission.wait_drained(max(timeout, 0.0))
         if not drained:
@@ -915,7 +996,14 @@ class Server:
         # final group-commit flush AFTER the listener is gone (no new
         # submissions) and BEFORE the holder closes the WAL files
         self.committer.close()
+        if self.rollup is not None:
+            self.rollup.close()
         if self.cluster is not None:
             self.cluster.close()
         self.api.executor.close()
+        # release this server's on-disk event log handle (the journal
+        # itself is process-wide and keeps its ring)
+        from ..utils.events import EVENTS
+        if self.config.event_log:
+            EVENTS.close_log()
         self.holder.close()

@@ -53,6 +53,7 @@ from ..core import (
     SHARD_WORDS,
 )
 from ..ops import bitset, bsi
+from ..utils import events
 from ..utils.durable import checksum, durable_replace, fsync_dir, fsync_file
 from ..utils.faults import FAULTS
 from ..utils.locks import make_lock, make_rlock
@@ -498,6 +499,11 @@ class Fragment:
                 pass  # marker is an optimization; reopen re-detects
         if count:
             _bump("quarantine")
+            # journaled state transition (docs/observability.md "Cluster
+            # plane"); sidecar reloads (count=False) are not new events
+            events.emit("storage.quarantine", index=self.index,
+                        field=self.field, view=self.view,
+                        shard=self.shard, reason=str(reason)[:160])
 
     def _check_writable(self):
         if self.quarantined is not None:

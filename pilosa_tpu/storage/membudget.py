@@ -51,6 +51,16 @@ class DeviceBudget:
         self.prefetch_hits = 0
         self.prefetch_misses = 0
         self._lock = make_rlock("budget")
+        # last eviction-pressure event (monotonic): one journal entry
+        # per PRESSURE_EVENT_MIN_S under sustained thrash, not one per
+        # make-room pass
+        self._pressure_emitted_at: float | None = None
+
+    # One make-room pass evicting this fraction of the limit is an
+    # eviction storm worth a timeline entry (docs/observability.md
+    # "Cluster plane"); smaller churn stays a counter.
+    PRESSURE_EVENT_FRACTION = 0.125
+    PRESSURE_EVENT_MIN_S = 5.0
 
     @property
     def resident_bytes(self) -> int:
@@ -116,13 +126,35 @@ class DeviceBudget:
                 self._total -= old[0]
                 self._compressed -= old[3]
                 pins = old[2]
+            evicted0 = self.evicted_bytes
             to_evict = self._evict_lru_locked(nbytes)
+            freed = self.evicted_bytes - evicted0
             self._entries[key] = [nbytes, evict, pins, compressed_bytes]
             self._total += nbytes
             self._compressed += compressed_bytes
             self._peak = max(self._peak, self._total)
             self.upload_bytes += nbytes
+        self._note_pressure(freed, len(to_evict))
         self._run_evictions(to_evict)
+
+    def _note_pressure(self, freed: int, n_evicted: int):
+        """Journal an eviction storm: one make-room pass that evicted a
+        large slice of the budget (rate-limited — sustained thrash is
+        one timeline entry per interval, with the counters carrying the
+        magnitude)."""
+        if self.limit_bytes is None or freed < max(
+                int(self.limit_bytes * self.PRESSURE_EVENT_FRACTION), 1):
+            return
+        import time as _time
+        now = _time.monotonic()
+        last = self._pressure_emitted_at
+        if last is not None and now - last < self.PRESSURE_EVENT_MIN_S:
+            return
+        self._pressure_emitted_at = now
+        from ..utils import events
+        events.emit("membudget.pressure", freedBytes=freed,
+                    entries=n_evicted, limitBytes=self.limit_bytes,
+                    residentBytes=self._total)
 
     def reset_peak(self):
         """Restart the high-water mark from the current residency (bench /

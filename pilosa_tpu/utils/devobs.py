@@ -141,6 +141,12 @@ class CompileRegistry:
         if retrace is None:
             return False
         prev_fp, n = retrace
+        # journal the retrace (docs/observability.md "Cluster plane"):
+        # the fleet timeline is where a retrace burst correlates with
+        # the p99 spike it caused; emit() never raises
+        from . import events
+        events.emit("device.retrace", sig=sig, kind=kind, compiles=n,
+                    shapes=fp)
         # Telemetry sinks must never take the query path down: the
         # injected logger outlives its Server (process-global registry,
         # most-recent-Server-wins), so a stale/closed stream is a lost

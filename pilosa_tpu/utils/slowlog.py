@@ -16,16 +16,23 @@ from collections import deque
 
 from .locks import make_lock
 
-# Query text stored per entry is truncated to this many characters: the
-# log must bound memory even against megabyte PQL bodies.
+# Default ceiling on query text stored per entry: the log must bound
+# memory even against megabyte PQL bodies.  Per-instance override via
+# the ``slow-log-text-max`` knob (a recorded-workload replay harness
+# wants entries it can replay VERBATIM, so it raises the ceiling and
+# skips the ones still marked ``textTruncated`` — bench.py's harvest).
 QUERY_TEXT_MAX = 512
 
 
 class SlowQueryLog:
     def __init__(self, threshold_s: float = 1.0, size: int = 128,
-                 logger=None, stats=None):
+                 logger=None, stats=None, text_max: int | None = None):
         self.threshold_s = threshold_s
         self.size = max(int(size), 1)
+        # `is not None`, not truthiness: an explicit 0 means "store no
+        # query text" (e.g. sensitive PQL bodies), not "use the default"
+        self.text_max = int(text_max) if text_max is not None \
+            else QUERY_TEXT_MAX
         self.logger = logger
         self.stats = stats
         self._entries: deque = deque(maxlen=self.size)
@@ -38,8 +45,10 @@ class SlowQueryLog:
 
     def record(self, *, index: str, query: str, duration_s: float,
                shards: int | None = None, trace_id: str | None = None,
-               status: int = 200, profile: dict | None = None):
-        query = (query or "")[:QUERY_TEXT_MAX]
+               status: int = 200, profile: dict | None = None,
+               explain: dict | None = None):
+        full_len = len(query or "")
+        query = (query or "")[:self.text_max]
         entry = {
             # wall stamp for operator correlation only; the duration was
             # measured by the caller from a perf_counter pair
@@ -51,8 +60,15 @@ class SlowQueryLog:
             "traceID": trace_id,
             "status": status,
         }
+        if full_len > len(query):
+            # an explicit flag, not a length heuristic: replay tooling
+            # must KNOW the text is partial (a truncated batch replays
+            # as a parse error — the PR 13 harvest bug)
+            entry["textTruncated"] = True
         if profile is not None:
             entry["profile"] = profile
+        if explain is not None:
+            entry["explain"] = explain
         with self._lock:
             self._entries.append(entry)
             self.recorded += 1
@@ -78,6 +94,7 @@ class SlowQueryLog:
         return {
             "thresholdS": self.threshold_s,
             "size": self.size,
+            "textMax": self.text_max,
             "recorded": self.recorded,
             "entries": entries,
         }

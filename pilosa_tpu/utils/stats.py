@@ -29,23 +29,40 @@ TIMING_BUCKETS = (
 class _Hist:
     """One timing series: count, sum, and per-bucket counters over the
     shared TIMING_BUCKETS edges.  Mutated under the owning client's
-    lock."""
+    lock.
 
-    __slots__ = ("count", "total", "buckets")
+    Each bucket also keeps its LAST trace-id exemplar (trace_id, value,
+    wall) — O(buckets) memory, and exactly the link a p99 investigation
+    needs: the `/metrics` exposition emits OpenMetrics-style exemplars
+    on the bucket lines, so the trace id behind a latency spike resolves
+    directly at ``/debug/traces?trace=<id>`` (docs/observability.md
+    "Trace exemplars")."""
+
+    __slots__ = ("count", "total", "buckets", "exemplars")
 
     def __init__(self):
         self.count = 0
         self.total = 0.0
         self.buckets = [0] * (len(TIMING_BUCKETS) + 1)
+        # per-bucket (trace_id, value, wall) of the last exemplar-tagged
+        # observation that landed there; None until one does
+        self.exemplars: list = [None] * (len(TIMING_BUCKETS) + 1)
 
-    def observe(self, v: float):
+    def observe(self, v: float, exemplar: str | None = None):
         self.count += 1
         self.total += v
         for i, b in enumerate(TIMING_BUCKETS):
             if v <= b:
                 self.buckets[i] += 1
+                if exemplar is not None:
+                    # lint: allow(wall-clock) — exemplar timestamps are
+                    # display-only correlation, never subtracted
+                    self.exemplars[i] = (exemplar, v, time.time())
                 return
         self.buckets[-1] += 1
+        if exemplar is not None:
+            # lint: allow(wall-clock) — display-only exemplar timestamp
+            self.exemplars[-1] = (exemplar, v, time.time())
 
     def percentile(self, q: float) -> float | None:
         """Order statistic estimated from the buckets with linear
@@ -112,9 +129,13 @@ class StatsClient:
         with self._lock:
             self._gauges[self._key(name)] = value
 
-    def timing(self, name: str, value_s: float, rate: float = 1.0):
+    def timing(self, name: str, value_s: float, rate: float = 1.0,
+               exemplar: str | None = None):
+        """``exemplar``: optional trace id attached to the bucket this
+        observation lands in (only pass ids of SAMPLED traces — an
+        exemplar must resolve at /debug/traces)."""
         with self._lock:
-            self._timings[self._key(name)].observe(value_s)
+            self._timings[self._key(name)].observe(value_s, exemplar)
 
     def histogram(self, name: str, value: float, rate: float = 1.0):
         self.timing(name, value, rate)
@@ -125,6 +146,13 @@ class StatsClient:
         with self._lock:
             h = self._timings.get(self._key(name))
             return None if h is None else h.percentile(q)
+
+    def count_value(self, name: str) -> float:
+        """One counter's current value without building the full
+        snapshot — the time-series sampler reads a handful per tick
+        (the timing_totals pattern)."""
+        with self._lock:
+            return self._counts.get(self._key(name), 0.0)
 
     def timing_totals(self, name: str) -> tuple[int, float]:
         """(count, sum) of one timing series without building the full
@@ -173,12 +201,18 @@ class StatsClient:
                     "gauges": dict(self._gauges),
                     "timings": timings}
 
-    def prometheus_text(self) -> str:
+    def prometheus_text(self, exemplars: bool = False) -> str:
         """Prometheus exposition format for /metrics
         (prometheus/prometheus.go:40).  Timings export as histogram
         families: cumulative ``_bucket{le=...}`` series over the shared
         TIMING_BUCKETS edges plus ``_sum``/``_count``, so p99 is
-        derivable with histogram_quantile."""
+        derivable with histogram_quantile.
+
+        ``exemplars=True`` appends the per-bucket trace-id exemplars in
+        OpenMetrics syntax — legal ONLY on the negotiated OpenMetrics
+        exposition (the classic 0.0.4 text parser rejects a ``# {...}``
+        token after a sample value, which would black out the whole
+        scrape); the handler sets it from the Accept header."""
         lines = []
 
         def fmt(name):
@@ -188,7 +222,8 @@ class StatsClient:
 
         snap = self.snapshot()
         with self._lock:
-            hists = {k: (h.count, h.total, list(h.buckets))
+            hists = {k: (h.count, h.total, list(h.buckets),
+                         list(h.exemplars))
                      for k, h in self._timings.items()}
         for k, v in sorted(snap["counts"].items()):
             lines.append(f"# TYPE {fmt(k).split('{')[0]} counter")
@@ -196,20 +231,39 @@ class StatsClient:
         for k, v in sorted(snap["gauges"].items()):
             lines.append(f"# TYPE {fmt(k).split('{')[0]} gauge")
             lines.append(f"{fmt(k)} {v}")
-        for k, (count, total, buckets) in sorted(hists.items()):
+
+        # bound before the histogram loop, whose per-series `exemplars`
+        # variable shadows the parameter inside the closure
+        with_exemplars = exemplars
+
+        def exemplar_suffix(ex):
+            # OpenMetrics exemplar syntax on the bucket the observation
+            # landed in: `... # {trace_id="<id>"} <value> <timestamp>` —
+            # the p99-spike -> /debug/traces link
+            # (docs/observability.md "Trace exemplars")
+            if ex is None or not with_exemplars:
+                return ""
+            tid, val, wall = ex
+            return (f' # {{trace_id="{tid}"}} {round(val, 6)}'
+                    f" {round(wall, 3)}")
+
+        for k, (count, total, buckets, exemplars) in \
+                sorted(hists.items()):
             full = fmt(k)
             base, _, tags = full.partition("{")
             tags = tags.rstrip("}")  # series tags, merged with le below
             prefix = ",".join(t for t in (tags,) if t)
             lines.append(f"# TYPE {base}_seconds histogram")
             cum = 0
-            for edge, c in zip(TIMING_BUCKETS, buckets):
+            for i, (edge, c) in enumerate(zip(TIMING_BUCKETS, buckets)):
                 cum += c
                 lbl = f'{prefix},le="{edge}"' if prefix else f'le="{edge}"'
-                lines.append(f"{base}_seconds_bucket{{{lbl}}} {cum}")
+                lines.append(f"{base}_seconds_bucket{{{lbl}}} {cum}"
+                             + exemplar_suffix(exemplars[i]))
             cum += buckets[-1]
             lbl = f'{prefix},le="+Inf"' if prefix else 'le="+Inf"'
-            lines.append(f"{base}_seconds_bucket{{{lbl}}} {cum}")
+            lines.append(f"{base}_seconds_bucket{{{lbl}}} {cum}"
+                         + exemplar_suffix(exemplars[-1]))
             suffix = "{" + prefix + "}" if prefix else ""
             lines.append(f"{base}_seconds_sum{suffix} {total}")
             lines.append(f"{base}_seconds_count{suffix} {count}")
@@ -334,8 +388,9 @@ class StatsdClient(StatsClient):
         super().gauge(name, value, rate)
         self._send(f"{name}:{value}|g")
 
-    def timing(self, name: str, value_s: float, rate: float = 1.0):
-        super().timing(name, value_s, rate)
+    def timing(self, name: str, value_s: float, rate: float = 1.0,
+               exemplar: str | None = None):
+        super().timing(name, value_s, rate, exemplar)
         self._send(f"{name}:{value_s * 1e3:.3f}|ms")
 
     def histogram(self, name: str, value: float, rate: float = 1.0):

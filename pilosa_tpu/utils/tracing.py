@@ -258,6 +258,41 @@ class Tracer:
             out = [s for s in out if s["traceID"] == trace_id]
         return out
 
+    def search(self, index: str | None = None,
+               min_duration_s: float | None = None,
+               status: int | None = None,
+               limit: int = 100) -> list[dict]:
+        """Trace summaries over the (bounded) retained ring, filtered by
+        the ROOT span's tags — ``index``, minimum duration, final HTTP
+        ``status`` (the handler stamps both onto its root span).  The
+        drill-down path behind a histogram exemplar: find the spike's
+        neighbors by index/duration, then fetch the full tree with
+        ``?trace=<id>`` (docs/observability.md "Trace exemplars")."""
+        all_spans = self.spans()
+        by_trace: dict[str, int] = {}
+        for s in all_spans:
+            by_trace[s["traceID"]] = by_trace.get(s["traceID"], 0) + 1
+        out = []
+        for s in all_spans:
+            if s.get("parentID") is not None or s.get("remote"):
+                continue  # roots only (remote roots summarize elsewhere)
+            tags = s.get("tags") or {}
+            if index is not None and tags.get("index") != index:
+                continue
+            if status is not None and tags.get("status") != status:
+                continue
+            dur = s.get("durationMS")
+            if min_duration_s is not None and \
+                    (dur is None or dur < min_duration_s * 1e3):
+                continue
+            out.append({"traceID": s["traceID"], "name": s["name"],
+                        "start": s.get("start"), "durationMS": dur,
+                        "index": tags.get("index"),
+                        "status": tags.get("status"),
+                        "spans": by_trace[s["traceID"]]})
+        out.sort(key=lambda t: t.get("start") or 0.0, reverse=True)
+        return out[:max(limit, 1)]
+
 
 GLOBAL_TRACER = Tracer()
 

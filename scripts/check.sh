@@ -46,10 +46,17 @@ python -m pilosa_tpu.analysis
 # bug in either silently corrupts or silently truncates answers.  The
 # fast deterministic subset (real-socket ChaosProxy faults) runs here;
 # the 20-cycle churn soak is pytest -m slow.
+# The cluster-observability suite (docs/observability.md "Cluster
+# plane") rides along: the event journal's framed-log torn-tail
+# recovery is a durability contract, EXPLAIN answers must stay
+# byte-identical to explain-off, and the fleet rollup must agree with
+# per-node /debug/vars golden — silent drift in any of them turns the
+# operable-cluster story into a lie.
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' -p no:cacheprovider \
     tests/test_durability.py tests/test_crash.py tests/test_containers.py \
     tests/test_device_obs.py tests/test_ingest.py tests/test_wholequery.py \
-    tests/test_routing.py tests/test_churn.py
+    tests/test_routing.py tests/test_churn.py \
+    tests/test_events.py tests/test_explain.py tests/test_cluster_obs.py
 
 # committed bytecode/cache artifacts must never land in the tree (shell
 # stays the right layer for a git-index check)

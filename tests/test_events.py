@@ -1,0 +1,289 @@
+"""Event journal (utils/events.py, docs/observability.md "Cluster
+plane"): ring + cursor semantics, the framed on-disk log's torn-tail
+recovery, emission from real state-transition sites (breaker,
+backpressure, drain), the /debug/events endpoint, and the event-names
+analyzer rule's two-way catalog check."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.utils.events import (EVENT_LOG_MAGIC, EVENTS,
+                                     EventJournal)
+
+from test_observability import _req, make_server
+
+
+# -- ring + cursor -----------------------------------------------------------
+
+
+def test_emit_seq_and_since_cursor():
+    j = EventJournal(size=16)
+    j.node_id = "nodeX"
+    first = j.emit("breaker.open", host="h1", fails=5)
+    assert first["seq"] == 1
+    assert first["node"] == "nodeX"
+    assert first["event"] == "breaker.open"
+    for i in range(4):
+        j.emit("node.down", peer=f"n{i}", reason="r")
+    assert j.last_seq() == 5
+    # cursor: strictly-after semantics, oldest first
+    tail = j.since(1)
+    assert [e["seq"] for e in tail] == [2, 3, 4, 5]
+    assert j.since(5) == []
+    # limit keeps the OLDEST entries: a cursor-advancing reader (the
+    # fleet rollup) resumes losslessly from the last seq it folded,
+    # instead of skipping the burst's middle forever
+    lim = j.since(0, limit=2)
+    assert [e["seq"] for e in lim] == [1, 2]
+    assert [e["seq"] for e in j.since(2, limit=2)] == [3, 4]
+    assert j.since(0, limit=0) == []
+
+
+def test_ring_bound_and_resize():
+    j = EventJournal(size=4)
+    for i in range(10):
+        j.emit("node.up", peer=f"n{i}")
+    snap = j.snapshot()
+    assert len(snap["events"]) == 4
+    assert snap["emitted"] == 10
+    assert [e["seq"] for e in snap["events"]] == [7, 8, 9, 10]
+    j.resize(2)
+    assert [e["seq"] for e in j.snapshot()["events"]] == [9, 10]
+    # None-valued fields are dropped, not serialized as null
+    e = j.emit("node.down", peer="n1", reason=None)
+    assert "reason" not in e
+
+
+def test_emit_never_raises_on_dead_log(tmp_path):
+    j = EventJournal(size=8)
+    j.open_log(str(tmp_path / "nodir" / "deeper" / "events.log"))
+    assert j.write_errors == 1  # open failed, counted
+    e = j.emit("server.drain", budgetS=1.0)  # ring still records
+    assert e["seq"] == 1
+
+
+# -- framed on-disk log ------------------------------------------------------
+
+
+def test_log_round_trip_and_reopen(tmp_path):
+    path = str(tmp_path / "events.log")
+    j = EventJournal(size=8)
+    j.open_log(path)
+    j.emit("breaker.open", host="h", fails=3)
+    j.emit("breaker.close", host="h")
+    j.close_log()
+    got = EventJournal.read_log(path)
+    assert [e["event"] for e in got] == ["breaker.open", "breaker.close"]
+    assert got[0]["fails"] == 3
+    # reopen appends after the existing frames
+    j2 = EventJournal(size=8)
+    j2.open_log(path)
+    j2.emit("node.up", peer="n2")
+    j2.close_log()
+    assert [e["event"] for e in EventJournal.read_log(path)] == \
+        ["breaker.open", "breaker.close", "node.up"]
+
+
+def test_log_torn_tail_truncates_at_frame_boundary(tmp_path):
+    path = str(tmp_path / "events.log")
+    j = EventJournal(size=8)
+    j.open_log(path)
+    j.emit("node.down", peer="a", reason="x")
+    j.emit("node.up", peer="a")
+    j.close_log()
+    whole = os.path.getsize(path)
+    # tear mid-frame: drop the last 3 bytes of the final frame
+    with open(path, "r+b") as f:
+        f.truncate(whole - 3)
+    j2 = EventJournal(size=8)
+    j2.open_log(path)
+    j2.emit("server.drain", budgetS=2.0)
+    j2.close_log()
+    events = EventJournal.read_log(path)
+    # the torn second frame is gone; the valid prefix + new frame remain
+    assert [e["event"] for e in events] == ["node.down", "server.drain"]
+
+
+def test_log_corrupt_byte_truncates(tmp_path):
+    path = str(tmp_path / "events.log")
+    j = EventJournal(size=8)
+    j.open_log(path)
+    j.emit("node.down", peer="a", reason="x")
+    j.emit("node.up", peer="a")
+    j.close_log()
+    data = open(path, "rb").read()
+    # flip one payload byte of frame 2 -> CRC mismatch -> truncate there
+    flip_at = len(data) - 4
+    with open(path, "r+b") as f:
+        f.seek(flip_at)
+        b = f.read(1)
+        f.seek(flip_at)
+        f.write(bytes([b[0] ^ 0xFF]))
+    assert [e["event"] for e in EventJournal.read_log(path)] == \
+        ["node.down"]
+    j2 = EventJournal(size=8)
+    j2.open_log(path)  # truncates the bad tail durably
+    j2.close_log()
+    assert os.path.getsize(path) < len(data)
+    data2 = open(path, "rb").read()
+    assert data2.startswith(EVENT_LOG_MAGIC)
+
+
+def test_garbage_file_rewritten(tmp_path):
+    path = str(tmp_path / "events.log")
+    with open(path, "wb") as f:
+        f.write(b"not an event log at all")
+    j = EventJournal(size=8)
+    j.open_log(path)
+    j.emit("node.up", peer="z")
+    j.close_log()
+    assert [e["event"] for e in EventJournal.read_log(path)] == \
+        ["node.up"]
+
+
+# -- real emission sites -----------------------------------------------------
+
+
+def test_breaker_transitions_emit_events():
+    from pilosa_tpu.parallel.cluster import CircuitOpenError, InternalClient
+    seq0 = EVENTS.last_seq()
+    c = InternalClient(breaker_threshold=2)
+    try:
+        for _ in range(2):
+            c._breaker_failure("hostA:1")
+        names = [e["event"] for e in EVENTS.since(seq0)]
+        assert "breaker.open" in names
+        # open breaker: fail fast, no new transition event
+        with pytest.raises(CircuitOpenError):
+            c._breaker_allow("hostA:1")
+        # the probe's trial admission is the half-open transition
+        c._breaker_allow("hostA:1", trial=True)
+        c._breaker_success("hostA:1")
+        names = [e["event"] for e in EVENTS.since(seq0)]
+        assert names.count("breaker.open") == 1
+        assert "breaker.half_open" in names
+        assert "breaker.close" in names
+    finally:
+        c.close()
+
+
+def test_backpressure_engage_release_events(tmp_path):
+    from pilosa_tpu.ingest.committer import GroupCommitter
+    from pilosa_tpu.storage import Holder
+    holder = Holder(str(tmp_path / "h"))
+    holder.open()
+    try:
+        com = GroupCommitter(holder, flush_ms=0, high_water_bytes=64)
+        seq0 = EVENTS.last_seq()
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        com.submit("i", "f", rows=list(range(16)), cols=list(range(16)))
+        assert com.wait_capacity(timeout=0.01) is False  # over high-water
+        names = [e["event"] for e in EVENTS.since(seq0)]
+        assert names.count("ingest.backpressure_engage") == 1
+        # second refusal in the same episode: no duplicate engage event
+        assert com.wait_capacity(timeout=0.01) is False
+        names = [e["event"] for e in EVENTS.since(seq0)]
+        assert names.count("ingest.backpressure_engage") == 1
+        com.wait_flushed(com._submit_seq)  # inline flush drains it
+        names = [e["event"] for e in EVENTS.since(seq0)]
+        assert "ingest.backpressure_release" in names
+        com.close()
+    finally:
+        holder.close()
+
+
+def test_server_drain_event_and_debug_events_endpoint(tmp_path):
+    srv = make_server(tmp_path, slow_query_threshold=0)
+    p = srv.port
+    try:
+        seq0 = EVENTS.last_seq()
+        EVENTS.emit("node.up", peer="synthetic")
+        out, _ = _req(p, "GET", f"/debug/events?since={seq0}")
+        assert [e["event"] for e in out["events"]] == ["node.up"]
+        assert out["seq"] >= seq0 + 1
+        # no cursor: full snapshot shape
+        full, _ = _req(p, "GET", "/debug/events")
+        assert full["size"] == srv.config.event_journal_size
+        assert any(e["event"] == "node.up" for e in full["events"])
+        # limit applies
+        lim, _ = _req(p, "GET", "/debug/events?limit=1")
+        assert len(lim["events"]) == 1
+    finally:
+        seq1 = EVENTS.last_seq()
+        srv.close()
+    assert any(e["event"] == "server.drain"
+               for e in EVENTS.since(seq1 - 1))
+
+
+def test_event_log_knob_persists_across_restart(tmp_path):
+    srv = make_server(tmp_path, name="n", event_log=True,
+                      slow_query_threshold=0)
+    try:
+        EVENTS.emit("node.down", peer="x", reason="test")
+        path = os.path.join(os.path.expanduser(srv.config.data_dir),
+                            "events.log")
+        assert os.path.exists(path)
+    finally:
+        srv.close()
+    events = EventJournal.read_log(path)
+    assert any(e["event"] == "node.down" and e["peer"] == "x"
+               for e in events)
+    assert any(e["event"] == "server.drain" for e in events)
+
+
+# -- event-names analyzer rule ----------------------------------------------
+
+
+CATALOG_DOC = """# obs
+<!-- events-catalog:begin -->
+| event | fields | meaning |
+|---|---|---|
+| `breaker.open` | `host` | x |
+<!-- events-catalog:end -->
+"""
+
+
+def _run_event_rule(tmp_path, code, doc=CATALOG_DOC):
+    from pilosa_tpu.analysis.astlint import run as lint_run
+    pkg = tmp_path / "pilosa_tpu"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "mod.py").write_text(code)
+    docs = tmp_path / "docs"
+    docs.mkdir(exist_ok=True)
+    (docs / "observability.md").write_text(doc)
+    findings = lint_run(tmp_path, rule_ids=["event-names"])
+    return [f.message for f in findings]
+
+
+def test_event_names_rule_flags_uncataloged_emit(tmp_path):
+    msgs = _run_event_rule(
+        tmp_path,
+        "from .utils import events\n"
+        "events.emit('breaker.open', host='h')\n"
+        "events.emit('breaker.opeen', host='h')\n")
+    assert any("breaker.opeen" in m for m in msgs)
+    assert not any("'breaker.open'" in m for m in msgs)
+
+
+def test_event_names_rule_flags_dangling_row(tmp_path):
+    msgs = _run_event_rule(
+        tmp_path,
+        "from .utils import events\n"
+        "events.emit('breaker.open', host='h')\n",
+        doc=CATALOG_DOC.replace(
+            "| `breaker.open` | `host` | x |",
+            "| `breaker.open` | `host` | x |\n"
+            "| `ghost.event` | | never emitted |"))
+    assert any("ghost.event" in m for m in msgs)
+
+
+def test_event_names_rule_clean_on_match(tmp_path):
+    msgs = _run_event_rule(
+        tmp_path,
+        "from .utils import events\n"
+        "events.emit('breaker.open', host='h')\n")
+    assert msgs == []

@@ -319,6 +319,10 @@ def _parse_prometheus(text):
                 fam, typ = rest.split()
                 types[fam] = typ
             continue
+        # OpenMetrics exemplar suffix (` # {trace_id="..."} v ts`) is
+        # metadata, not the sample value — strip it like a real
+        # exemplar-aware scraper does
+        line = line.split(" # ")[0]
         metric, _, value = line.rpartition(" ")
         name, _, labelstr = metric.partition("{")
         labels = frozenset(
@@ -336,11 +340,20 @@ def test_metrics_histogram_round_trip(tmp_path):
         _req(p, "POST", "/index/i/field/f", {})
         for _ in range(3):
             _req(p, "POST", "/index/i/query", "Count(Row(f=1))")
-        r = urllib.request.Request(f"http://localhost:{p}/metrics")
-        with urllib.request.urlopen(r, timeout=30) as resp:
-            text = resp.read().decode()
-        types, samples = _parse_prometheus(text)
         fam = "pilosa_tpu_http_query_seconds"
+        # the histogram observation lands in post-response accounting
+        # (the _observe finally block), so poll the scrape until the
+        # last query's sample settles
+        deadline = time.monotonic() + 5.0
+        while True:
+            r = urllib.request.Request(f"http://localhost:{p}/metrics")
+            with urllib.request.urlopen(r, timeout=30) as resp:
+                text = resp.read().decode()
+            types, samples = _parse_prometheus(text)
+            if samples.get((f"{fam}_count", frozenset())) == 3 \
+                    or time.monotonic() > deadline:
+                break
+            time.sleep(0.01)
         assert types[fam] == "histogram"
         buckets = sorted(
             ((float(next(iter(ls)).split('"')[1])
