@@ -1087,6 +1087,270 @@ def run_chaos_smoke(rng) -> dict:
     return out
 
 
+def _wire_leg(rng, *, waves=4, wave_q=48, threads=8, n_shards=4,
+              dense_rows=6, dense_bits=320000, sparse_rows=6,
+              sparse_run=3000, fallback_check=False):
+    """Internal-wire leg (docs/cluster.md "Internal query wire"): 2 real
+    server nodes where the coordinator (node0) owns NO shard of either
+    bench index — "w1" and "qx" jump-hash every shard onto node1 — so
+    every query is a pure remote fan-out and the internal wire carries
+    all result traffic.  The SAME recorded corpus replays once over the
+    PTPUQRY1 binary wire and once with every node pinned
+    internal-wire=json (the PR 16 knob, flipped in-process between
+    passes); answers are asserted byte-identical, and qps, wire
+    bytes/query, and the per-wave wire-vs-reduce time split come off the
+    cluster counters (cluster.wire_bytes_*, cluster.multi.wire_overhead
+    / cluster.multi.reduce — same series both wires).
+
+    Two corpora, matching the wire's two size regimes: a DENSE Row-heavy
+    one ("w1": scattered random bits, segments ride raw or
+    bitmap-packed; the JSON wire pays zlib+base64 of every 128 KiB
+    segment either way, so this is the qps headline) and a SPARSE
+    clustered one ("qx": short runs, roaring-packs to a few hundred
+    bytes; this is the bytes/query headline)."""
+    import http.client
+    import socket
+    import tempfile
+    import threading
+
+    from pilosa_tpu.core import SHARD_WIDTH
+    from pilosa_tpu.server import Config, Server
+
+    socks = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = []
+
+    def post(port, path, body: bytes, timeout=600):
+        conn = http.client.HTTPConnection("localhost", port,
+                                          timeout=timeout)
+        conn.request("POST", path, body=body)
+        resp = conn.getresponse()
+        data = resp.read()
+        conn.close()
+        if resp.status != 200:
+            raise RuntimeError(f"{path}: {resp.status} {data[:200]!r}")
+        return json.loads(data)
+
+    def set_wire(mode):
+        # flip the knob in-process between passes: the serving branch
+        # keys off cluster.internal_wire, the dispatch side off
+        # client.wire_mode; clear the per-peer latches so the new mode
+        # starts from a clean negotiation state
+        for srv in servers:
+            srv.cluster.internal_wire = mode
+            srv.cluster.client.wire_mode = mode
+            srv.cluster.client._wire_down.clear()
+            srv.cluster.client._peer_wire.clear()
+
+    try:
+        for i, p in enumerate(ports):
+            srv = Server(Config(
+                data_dir=tempfile.mkdtemp(prefix=f"ptpu_wire_{i}_"),
+                bind=hosts[i], node_id=f"node{i}", cluster_hosts=hosts,
+                replica_n=1, anti_entropy_interval=0,
+                internal_wire="bin1"))
+            servers.append(srv)
+            srv.open()
+        p0 = ports[0]
+        span = n_shards * SHARD_WIDTH
+        for name in ("w1", "qx"):
+            post(p0, f"/index/{name}", b"{}")
+            post(p0, f"/index/{name}/field/a", b"{}")
+        # seed through the coordinator's api IN-PROCESS (the public
+        # import JSON adds nothing here); the cluster import fan-out
+        # still routes each shard batch to its owner.  Dense rows are
+        # scattered at ~dense_bits/n_shards bits per segment — dense
+        # enough that the JSON wire's per-segment zlib actually costs
+        # what it costs in production, which is the regime the binary
+        # wire exists for.
+        for r in range(dense_rows):
+            cols = np.unique(rng.integers(0, span, size=dense_bits))
+            servers[0].api.import_bits(
+                "w1", "a", [r] * cols.size, cols.tolist())
+        for r in range(sparse_rows):
+            # short runs near the base of each shard: roaring run/array
+            # containers, a few hundred wire bytes per packed segment
+            cols = np.concatenate([
+                np.arange(s * SHARD_WIDTH + r * sparse_run,
+                          s * SHARD_WIDTH + (r + 1) * sparse_run)
+                for s in range(n_shards)])
+            servers[0].api.import_bits(
+                "qx", "a", [r] * cols.size, cols.tolist())
+
+        def gen_dense():
+            a = int(rng.integers(0, dense_rows))
+            b = (a + 1 + int(rng.integers(0, dense_rows - 1))) \
+                % dense_rows
+            kind = int(rng.integers(0, 3))
+            if kind == 0:
+                q = f"Row(a={a})Row(a={b})"
+            elif kind == 1:
+                q = f"Union(Row(a={a}), Row(a={b}))Count(Row(a={a}))"
+            else:
+                q = f"Row(a={a})Intersect(Row(a={a}), Row(a={b}))"
+            return "w1", q
+
+        def gen_sparse():
+            a = int(rng.integers(0, sparse_rows))
+            b = (a + 1) % sparse_rows
+            return "qx", f"Row(a={a})Row(a={b})"
+
+        dense_corpus = [gen_dense() for _ in range(wave_q)]
+        sparse_corpus = [gen_sparse() for _ in range(wave_q)]
+        stats = servers[0].stats
+
+        def counters():
+            return {
+                "bytes": stats.count_value("cluster.wire_bytes_tx")
+                + stats.count_value("cluster.wire_bytes_rx"),
+                "frames": stats.count_value("cluster.wire_frames"),
+                "fallback": stats.count_value("cluster.wire_fallback"),
+                "wire_s": stats.timing_totals(
+                    "cluster.multi.wire_overhead")[1],
+                "reduce_s": stats.timing_totals(
+                    "cluster.multi.reduce")[1],
+            }
+
+        # replay: recorded corpus, threaded like production fan-in, but
+        # dispatched through the coordinator's api.query IN-PROCESS —
+        # the public HTTP+JSON surface is identical in both modes and
+        # would dilute the internal-wire signal this leg exists to
+        # measure.  Two passes: an UNTIMED identity pass that captures
+        # every answer in public wire form (result_to_wire — exactly
+        # what a client would see, for the byte-identity assert), then
+        # the timed pass, pure dispatch with results consumed but not
+        # re-serialized.  Returns qps + answers + the counter deltas of
+        # the timed window.
+        from pilosa_tpu.parallel.cluster import result_to_wire
+
+        def replay(corpus, n):
+            answers = {}
+            for i, (idx, q) in enumerate(corpus):
+                res = servers[0].api.query(idx, q)
+                answers[i] = json.dumps(
+                    [result_to_wire(r) for r in res], sort_keys=True)
+
+            def post_one(item):
+                _i, (idx, q) = item
+                servers[0].api.query(idx, q)
+
+            items = [(i, corpus[i % len(corpus)]) for i in range(n)]
+            c0 = counters()
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(threads) as pool:
+                list(pool.map(post_one, items))
+            dt = time.perf_counter() - t0
+            c1 = counters()
+            d = {k: c1[k] - c0[k] for k in c0}
+            return {
+                "qps": n / dt,
+                "answers": answers,
+                "bytes_per_q": d["bytes"] / n,
+                "frames_per_q": d["frames"] / n,
+                "fallback": d["fallback"],
+                "wire_ms_per_q": d["wire_s"] / n * 1e3,
+                "reduce_ms_per_q": d["reduce_s"] / n * 1e3,
+            }
+
+        runs = {}
+        for mode in ("bin1", "json"):
+            set_wire(mode)
+            for idx, q in dense_corpus[:4] + sparse_corpus[:4]:
+                servers[0].api.query(idx, q)  # warm compiles + wire
+            runs[mode] = {
+                "dense": replay(dense_corpus, waves * wave_q),
+                "sparse": replay(sparse_corpus, wave_q),
+            }
+        for leg in ("dense", "sparse"):
+            assert runs["bin1"][leg]["answers"] == \
+                runs["json"][leg]["answers"], \
+                f"binary wire diverged from JSON answers ({leg})"
+
+        out = {
+            "answers_identical": True,
+            "qps_bin1": round(runs["bin1"]["dense"]["qps"], 1),
+            "qps_json": round(runs["json"]["dense"]["qps"], 1),
+            "bin1_vs_json": round(runs["bin1"]["dense"]["qps"]
+                                  / runs["json"]["dense"]["qps"], 2),
+            "dense_wire_bytes_per_q": {
+                m: int(runs[m]["dense"]["bytes_per_q"])
+                for m in runs},
+            "sparse_wire_bytes_per_q": {
+                m: int(runs[m]["sparse"]["bytes_per_q"])
+                for m in runs},
+            "sparse_bytes_ratio": round(
+                runs["json"]["sparse"]["bytes_per_q"]
+                / runs["bin1"]["sparse"]["bytes_per_q"], 2),
+            "wire_ms_per_q": {
+                m: round(runs[m]["dense"]["wire_ms_per_q"], 3)
+                for m in runs},
+            "reduce_ms_per_q": {
+                m: round(runs[m]["dense"]["reduce_ms_per_q"], 3)
+                for m in runs},
+            "frames_per_q_bin1": round(
+                runs["bin1"]["dense"]["frames_per_q"], 1),
+        }
+        if fallback_check:
+            # mixed-version exercise: node1 pinned json, node0 still
+            # binary and force-marked optimistic — the first POST must
+            # 415, downgrade-latch, retry as JSON, and answer
+            # identically
+            servers[1].cluster.internal_wire = "json"
+            cl0 = servers[0].cluster
+            cl0.internal_wire = "bin1"
+            cl0.client.wire_mode = "bin1"
+            cl0.client._wire_down.clear()
+            host1 = cl0.nodes[1].host
+            cl0.client._peer_wire[host1] = "bin1"
+            fb0 = stats.count_value("cluster.wire_fallback")
+            idx, q = sparse_corpus[0]
+            res = servers[0].api.query(idx, q)
+            got = json.dumps([result_to_wire(r) for r in res],
+                             sort_keys=True)
+            fb = stats.count_value("cluster.wire_fallback") - fb0
+            assert fb >= 1, "415 downgrade never fired"
+            assert got == runs["bin1"]["sparse"]["answers"][0], \
+                "downgraded answer diverged"
+            out["fallback"] = {"count": int(fb),
+                               "answers_identical": True}
+        return out
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            # lint: allow(swallowed-exception) — bench teardown; the
+            # server may already be down and the leg's numbers are in
+            except Exception:
+                pass
+
+
+def bench_wire(rng):
+    """Main-bench internal-wire leg: binary vs JSON at full wave counts
+    on the recorded dense + sparse corpora (see _wire_leg)."""
+    return _wire_leg(rng, waves=5, wave_q=48, threads=8)
+
+
+def run_wire_smoke(rng) -> dict:
+    """Wire leg of --smoke (docs/cluster.md "Internal query wire"):
+    small corpus; asserts answers byte-identical across wires, sparse
+    wire bytes/query actually reduced by the roaring framing, and the
+    mixed-version 415 downgrade exercised end-to-end."""
+    out = _wire_leg(rng, waves=2, wave_q=16, threads=6,
+                    dense_rows=4, dense_bits=240000, sparse_run=1500,
+                    fallback_check=True)
+    assert out["sparse_bytes_ratio"] > 1.5, \
+        f"binary wire did not shrink sparse results: {out}"
+    assert out["fallback"]["count"] >= 1, out
+    return out
+
+
 # -- numpy oracle baselines (single-thread reference-algorithm stand-in) ----
 
 def _np_frag(holder, index, field, view=None):
@@ -2175,6 +2439,7 @@ def run_smoke():
         np.random.default_rng(SEED + 9))
     out["routing"] = run_routing_smoke(np.random.default_rng(SEED + 10))
     out["chaos"] = run_chaos_smoke(np.random.default_rng(SEED + 11))
+    out["wire"] = run_wire_smoke(np.random.default_rng(SEED + 12))
     out["compressed"] = run_compressed_smoke(np.random.default_rng(SEED + 6))
     out["ingest"] = run_ingest_smoke(np.random.default_rng(SEED + 8))
     out["cache"] = run_cache_smoke(np.random.default_rng(SEED + 3))
@@ -2273,6 +2538,17 @@ def main():
         print(f"chaos config failed: {e!r}", file=sys.stderr)
         traceback.print_exc()
         chaos_leg = None
+
+    # internal-wire config (docs/cluster.md "Internal query wire"):
+    # binary PTPUQRY1 vs JSON envelope on the same recorded fan-out
+    # corpus, answers asserted byte-identical
+    try:
+        wire_leg = bench_wire(np.random.default_rng(SEED + 12))
+    except Exception as e:
+        import traceback
+        print(f"wire config failed: {e!r}", file=sys.stderr)
+        traceback.print_exc()
+        wire_leg = None
 
     # concurrent-HTTP dynamic-batching config (docs/batching.md): the
     # served single-query path, dispatch-batch on vs off
@@ -2378,6 +2654,8 @@ def main():
         configs["10_elastic_routing"] = routing_leg
     if chaos_leg:
         configs["11_tail_tolerance_chaos"] = chaos_leg
+    if wire_leg:
+        configs["12_internal_wire"] = wire_leg
 
     print(json.dumps({
         "metric": "engine_intersect8_count_qps_1M_cols",

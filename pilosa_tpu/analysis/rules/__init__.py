@@ -9,6 +9,7 @@ from . import (  # noqa: F401
     metrics_docs,
     router_bypass,
     thread_context,
+    tier1_legs,
     traced_closure,
     wallclock,
 )

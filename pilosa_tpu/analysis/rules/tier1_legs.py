@@ -1,0 +1,41 @@
+"""tier1-legs: the split tier-1 runner's leg partition covers tests/.
+
+scripts/tier1_split.sh runs the tier-1 suite as two explicitly-listed
+legs (the suite stopped fitting one timeout budget on a 1-core box).
+An explicit list rots: a new test file that lands in NEITHER leg simply
+never runs in split-mode tier-1, and nothing would say so.  This rule
+makes the partition load-bearing — every ``tests/test_*.py`` on disk
+must appear in the script, and every listed file must still exist.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..astlint import Finding, project_rule
+
+LISTED = re.compile(r"\btests/test_\w+\.py\b")
+
+
+@project_rule("tier1-legs")
+def check(modules, root):
+    """Test files outside both tier-1 legs / stale leg entries."""
+    script_path = root / "scripts" / "tier1_split.sh"
+    script_rel = "scripts/tier1_split.sh"
+    if not script_path.is_file():
+        yield Finding("tier1-legs", script_rel, 1,
+                      "scripts/tier1_split.sh is missing")
+        return
+    text = script_path.read_text()
+    listed = set(LISTED.findall(text))
+    on_disk = {f"tests/{p.name}"
+               for p in (root / "tests").glob("test_*.py")}
+    for f in sorted(on_disk - listed):
+        yield Finding("tier1-legs", f, 1,
+                      f"{f} is in neither leg of scripts/tier1_split.sh "
+                      f"— it never runs in split-mode tier-1; add it to "
+                      f"a leg list")
+    for f in sorted(listed - on_disk):
+        line = text[:text.index(f)].count("\n") + 1
+        yield Finding("tier1-legs", script_rel, line,
+                      f"leg entry {f} does not exist on disk")

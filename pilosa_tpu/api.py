@@ -54,6 +54,14 @@ class DisallowedError(ApiError):
     """Method not allowed in current cluster state (api.go:119 validate)."""
 
 
+class UnsupportedMediaTypeError(ApiError):
+    """Request body format the handler does not accept — HTTP 415.  The
+    capability-mismatch signal of internal query wire negotiation: a
+    node pinned to internal-wire=json answers binary /internal/query
+    POSTs with it, and the calling InternalClient downgrades that peer
+    to the JSON wire (docs/cluster.md "Internal query wire")."""
+
+
 class API:
     def __init__(self, holder: Holder, cluster=None, stats=None,
                  use_mesh: bool = True, dispatch_batch: bool = True,
@@ -399,6 +407,10 @@ class API:
             out["load"] = self.cluster.local_load()
             out["residency"] = self.cluster.residency_summary()
             out["overlayEpoch"] = self.cluster.overlay_epoch
+            # internal-query wire capability advertisement: peers' probe
+            # folds feed this to their InternalClient negotiation
+            # (docs/cluster.md "Internal query wire")
+            out["wire"] = self.cluster.wire_capabilities()
         out.update({"state": state, "nodes": nodes, "epoch": epoch,
                     "localID": nodes[0]["id"] if self.cluster is None
                     else self.cluster.node_id})

@@ -526,6 +526,10 @@ max-op-n = 10000
 # partial-results = false  # server default for ?partialResults: serve
 #                          # reads with unservable shards, naming the
 #                          # missing shards in the degraded object
+# internal-wire = "bin1"   # /internal/query transport: PTPUQRY1 framed
+#                          # binary (roaring-packed segments), per-peer
+#                          # negotiated; "json" restores the JSON
+#                          # envelope exactly (docs/cluster.md)
 # durability & recovery (docs/robustness.md)
 # wal-crc = true           # CRC-frame new WAL files (torn-tail recovery)
 # quarantine-on-corruption = true  # corrupt fragment -> quarantine +
@@ -611,6 +615,7 @@ def cmd_config(args) -> int:
     print(f"hedge-reads = {str(cfg.hedge_reads).lower()}")
     print(f"hedge-delay-ms = {cfg.hedge_delay_ms}")
     print(f"partial-results = {str(cfg.partial_results).lower()}")
+    print(f"internal-wire = {q(cfg.internal_wire)}")
     print(f"read-routing = {q(cfg.read_routing)}")
     print(f"residency-routing = {str(cfg.residency_routing).lower()}")
     print(f"balancer = {str(cfg.balancer).lower()}")

@@ -59,6 +59,7 @@ from ..utils.deadline import DEADLINE_HEADER, current as current_ctx
 from ..utils.faults import FAULTS
 from ..utils.locks import make_lock, make_rlock
 from ..utils.tracing import GLOBAL_TRACER, PROBE_HEADER, TRACE_HEADER
+from . import qwire
 from .placement import Placement
 
 NODE_READY = "READY"
@@ -202,11 +203,27 @@ class InternalClient:
     POOL_IDLE_MAX = 60.0
 
     def __init__(self, timeout: float = 30.0, breaker_threshold: int = 5,
-                 breaker_cooldown: float = 5.0, stats=None):
+                 breaker_cooldown: float = 5.0, stats=None,
+                 wire_mode: str = qwire.WIRE_BIN1):
         self.timeout = timeout
         self.breaker_threshold = breaker_threshold
         self.breaker_cooldown = breaker_cooldown
         self.stats = stats
+        # Internal query wire preference (docs/cluster.md "Internal query
+        # wire"): "bin1" speaks the PTPUQRY1 framed binary transport to
+        # peers that advertise it (or whose capability is still unknown —
+        # optimistic, pre-first-probe) and downgrades per-peer to the
+        # verbatim JSON path on refusal; "json" restores JSON exactly.
+        self.wire_mode = wire_mode
+        # host -> capability learned from its /status `wire` list; absent
+        # means unknown (optimistically binary).  Plain dicts mutated
+        # with single GIL-atomic ops, like _host_gen below.
+        self._peer_wire: dict[str, str] = {}
+        # host -> True after a 415/400 refusal of a binary POST; cleared
+        # when the peer's /status re-advertises bin1 (rolling-upgrade
+        # recovery — a restarted peer that now speaks binary gets it
+        # back within one health interval)
+        self._wire_down: dict[str, bool] = {}
         self._ssl_ctx = None
         # per-thread keep-alive connections (the server speaks HTTP/1.1):
         # a cluster fan-out must not pay a TCP handshake per sub-query
@@ -232,6 +249,46 @@ class InternalClient:
         thread lazily discard its stale conn and dial fresh (GIL-atomic
         int bump; racing requests see either generation, both safe)."""
         self._host_gen[host] = self._host_gen.get(host, 0) + 1
+
+    # -- internal query wire negotiation -----------------------------------
+
+    def note_peer_wire(self, host: str, caps):
+        """Fold a peer's advertised wire capability (its /status ``wire``
+        list) into the negotiation state.  A peer advertising bin1 clears
+        any earlier downgrade — the rolling-upgrade recovery path (a peer
+        that persists in refusing binary despite advertising it just
+        re-downgrades within its next RPC).  No ``wire`` key (an older
+        peer) reads as JSON-only."""
+        bin1 = isinstance(caps, (list, tuple)) and qwire.WIRE_BIN1 in caps
+        self._peer_wire[host] = qwire.WIRE_BIN1 if bin1 else qwire.WIRE_JSON
+        if bin1:
+            self._wire_down.pop(host, None)
+
+    def peer_wire_mode(self, host: str) -> str:
+        """The wire this client would speak to ``host`` right now:
+        binary when the client prefers it, the peer has not refused it,
+        and the peer's advertised capability is bin1 — or still UNKNOWN
+        (optimistic pre-probe: a refusal costs one downgraded retry,
+        while pessimism would leave the first health interval's whole
+        fan-out on JSON)."""
+        if self.wire_mode != qwire.WIRE_BIN1 or self._wire_down.get(host):
+            return qwire.WIRE_JSON
+        if self._peer_wire.get(host, qwire.WIRE_BIN1) != qwire.WIRE_BIN1:
+            return qwire.WIRE_JSON
+        return qwire.WIRE_BIN1
+
+    def _wire_downgrade(self, host: str, status: int):
+        """A peer refused a binary POST (415 from a new peer pinned to
+        internal-wire=json; 400 from an old peer that read PTPUQRY1 as a
+        broken JSON body): latch this host to the JSON wire and journal
+        the downgrade.  A genuine application-level 400 on the binary
+        path trips this too — the cost is one spurious JSON retry that
+        fails with the same error, and the next /status probe clears the
+        latch if the peer advertises bin1."""
+        self._wire_down[host] = True
+        if self.stats is not None:
+            self.stats.count("cluster.wire_fallback")
+        events.emit("wire.downgrade", host=host, status=status)
 
     # -- circuit breaker ---------------------------------------------------
 
@@ -503,14 +560,18 @@ class InternalClient:
                                      timeout=timeout, headers_extra=headers,
                                      breaker_trial=breaker_trial)
         if status >= 400:
-            try:
-                msg = json.loads(data).get("error", data.decode())
-            # lint: allow(swallowed-exception) — error-body decode
-            # fallback; the ClusterError below carries the raw body
-            except Exception:
-                msg = data.decode(errors="replace")
-            raise ClusterError(f"{host} {path}: {status} {msg}")
+            raise self._http_error(host, path, status, data)
         return json.loads(data) if data else {}
+
+    @staticmethod
+    def _http_error(host, path, status, data) -> ClusterError:
+        try:
+            msg = json.loads(data).get("error", data.decode())
+        # lint: allow(swallowed-exception) — error-body decode
+        # fallback; the ClusterError below carries the raw body
+        except Exception:
+            msg = data.decode(errors="replace")
+        return ClusterError(f"{host} {path}: {status} {msg}")
 
     # -- RPCs --------------------------------------------------------------
 
@@ -585,21 +646,67 @@ class InternalClient:
 
         The third return element is the peer's fragment-generation
         summary for the index (piggybacked so the coordinator can key
-        cross-node result-cache entries; cache/results.py)."""
+        cross-node result-cache entries; cache/results.py).  4th: the
+        peer's quarantined-fragment count — the coordinator folds it
+        into the response's degraded flag (utils/degraded.py).  5th: the
+        peer's admission-queue depth, piggybacked for the read router's
+        load scores (parallel/routing.py — the same piggyback pattern
+        as gens).
+
+        Rides the PTPUQRY1 binary wire when negotiation allows
+        (peer_wire_mode) and falls back to the verbatim JSON envelope on
+        refusal — same results, same piggybacks, byte-identical merged
+        answers either way (docs/cluster.md "Internal query wire")."""
         headers, timeout = self._deadline_extras(deadline_s, self.timeout)
-        out = self._json(host, "POST", f"/internal/query/{index}", {
-            "calls": [call_to_wire(c) for c in calls],
-            "shards": shards,
-        }, timeout=timeout, headers=headers)
+        path = f"/internal/query/{index}"
+        calls_wire = [call_to_wire(c) for c in calls]
+        if self.peer_wire_mode(host) == qwire.WIRE_BIN1:
+            body = qwire.encode_request(calls_wire, shards)
+            status, data = self._request(
+                host, "POST", path, body, ctype=qwire.CONTENT_TYPE,
+                timeout=timeout, headers_extra=headers)
+            if status < 400:
+                try:
+                    results, trailer, nframes = qwire.decode_response(data)
+                except qwire.FrameError as e:
+                    raise ClusterError(
+                        f"{host} {path}: bad binary response: {e}")
+                if self.stats is not None:
+                    # request frames (calls + shards) count too: the
+                    # bench's bytes/query split wants BOTH directions
+                    self.stats.count("cluster.wire_bytes_tx", len(body))
+                    self.stats.count("cluster.wire_bytes_rx", len(data))
+                    self.stats.count("cluster.wire_frames", nframes + 2)
+                GLOBAL_TRACER.adopt(trailer.get("spans"))
+                return (results, float(trailer.get("execS", 0.0)),
+                        trailer.get("gens"),
+                        int(trailer.get("quarantined", 0)),
+                        trailer.get("load"))
+            if status not in (415, 400):
+                raise self._http_error(host, path, status, data)
+            # 415: a bin1-capable peer pinned to internal-wire=json.
+            # 400: an old peer that read the frames as broken JSON.
+            # Either way: latch this host to JSON and retry the SAME
+            # request on the JSON wire — safe because every call through
+            # here is an idempotent internal read (writes fan out on
+            # their own paths and never ride query_calls).
+            self._wire_downgrade(host, status)
+        body = json.dumps({"calls": calls_wire,
+                           "shards": shards}).encode()
+        status, data = self._request(host, "POST", path, body,
+                                     timeout=timeout, headers_extra=headers)
+        if status >= 400:
+            raise self._http_error(host, path, status, data)
+        if self.stats is not None:
+            # counted on the JSON leg too, so bin1-vs-json bytes/query
+            # compare from the same counters (docs/observability.md)
+            self.stats.count("cluster.wire_bytes_tx", len(body))
+            self.stats.count("cluster.wire_bytes_rx", len(data))
+        out = json.loads(data) if data else {}
         # remote span summaries piggyback on the response (like the gen
-        # summaries below): fold them into the local ring so
-        # /debug/traces on the coordinator renders the whole cluster tree
+        # summaries): fold them into the local ring so /debug/traces on
+        # the coordinator renders the whole cluster tree
         GLOBAL_TRACER.adopt(out.get("spans"))
-        # 4th element: the peer's quarantined-fragment count for this
-        # index — the coordinator folds it into the response's degraded
-        # flag (utils/degraded.py).  5th: the peer's admission-queue
-        # depth, piggybacked for the read router's load scores
-        # (parallel/routing.py — the same piggyback pattern as gens).
         return ([result_from_wire(r) for r in out["results"]],
                 float(out.get("execS", 0.0)), out.get("gens"),
                 int(out.get("quarantined", 0)), out.get("load"))
@@ -897,7 +1004,18 @@ class Cluster:
                  balancer_interval: float = 30.0,
                  hot_shard_threshold: float = 4.0,
                  hedge_reads: bool = True,
-                 hedge_delay_ms: float = 0.0):
+                 hedge_delay_ms: float = 0.0,
+                 internal_wire: str = qwire.WIRE_BIN1):
+        if internal_wire not in (qwire.WIRE_JSON, qwire.WIRE_BIN1):
+            raise ClusterError(
+                f"internal_wire must be one of "
+                f"{[qwire.WIRE_JSON, qwire.WIRE_BIN1]}, "
+                f"got {internal_wire!r}")
+        # Internal query wire (docs/cluster.md "Internal query wire"):
+        # governs BOTH directions — what this node's client speaks to
+        # peers (subject to per-peer negotiation) and what its handler
+        # accepts (415 on binary POSTs when pinned to "json").
+        self.internal_wire = internal_wire
         self.nodes = [Node(f"node{i}", h) for i, h in enumerate(hosts)]
         self.by_id = {n.id: n for n in self.nodes}
         if node_id not in self.by_id:
@@ -919,7 +1037,7 @@ class Cluster:
             breaker_threshold=breaker_threshold,
             breaker_cooldown=max(health_interval, 1.0)
             if health_interval > 0 else 5.0,
-            stats=stats)
+            stats=stats, wire_mode=internal_wire)
         self.api = None
         self.state = STATE_STARTING
         self.health_interval = health_interval
@@ -1154,6 +1272,10 @@ class Cluster:
             # router (parallel/routing.py): the probe cadence keeps tier
             # preferences fresh even for peers the fan-out never hits
             self.router.note_status(n.id, st)
+            # fold the peer's advertised wire capability (clears a stale
+            # per-peer JSON downgrade once the peer speaks bin1 again —
+            # the rolling-upgrade recovery path)
+            self.client.note_peer_wire(n.host, st.get("wire"))
             if was_down:
                 # every pooled connection to the peer predates its
                 # outage/restart — invalidate them BEFORE any traffic
@@ -1412,6 +1534,16 @@ class Cluster:
         b = srv.admission_internal.snapshot()
         return {"inFlight": a["inUse"] + b["inUse"],
                 "queued": a["waiting"] + b["waiting"]}
+
+    def wire_capabilities(self) -> list[str]:
+        """The internal-query wire formats this node's handler accepts,
+        advertised on /status for peer negotiation (docs/cluster.md
+        "Internal query wire").  JSON is always accepted; bin1 only when
+        the internal-wire knob allows it."""
+        caps = [qwire.WIRE_JSON]
+        if self.internal_wire == qwire.WIRE_BIN1:
+            caps.append(qwire.WIRE_BIN1)
+        return caps
 
     # -- peer data-version registry (result-cache keying) ------------------
 
@@ -3473,45 +3605,81 @@ class Cluster:
             # admission pools
             self._server = server
 
-        def internal_query(req, args):
+        def _exec_multi(req, index, calls_wire, shards):
+            """Execute a multi-call batch and build its piggybacks —
+            shared by the JSON and PTPUQRY1 branches so the two wires
+            can never drift in semantics.  Returns (results, trailer):
+            the trailer is the piggyback dict (execS, gens, quarantined,
+            load, spans) that the JSON wire inlines into its response
+            object and the binary wire ships as its trailer frame."""
             from ..cache.results import gen_summary
+            calls = [call_from_wire(c) for c in calls_wire]
+            t0 = time.perf_counter()
+            res = cluster.api.executor.execute(
+                index, Query(calls), shards or [], translate=False)
+            # post-execution gen summary: lets the coordinator key its
+            # cross-node result-cache entries to the data this answer
+            # was computed from
+            trailer = {"execS": time.perf_counter() - t0,
+                       "gens": list(gen_summary(cluster.holder, index))}
+            # quarantined fragments answered as EMPTY: piggyback the
+            # count so the coordinator's response says so
+            # (utils/degraded.py, docs/robustness.md)
+            nq = len(cluster.holder.quarantined_fragments(index))
+            if nq:
+                trailer["quarantined"] = nq
+            # admission depth piggyback (parallel/routing.py): every
+            # answered sub-query refreshes the coordinator's load view
+            # of this node, like the gen summaries above
+            trailer["load"] = cluster.local_load()
+            # span summaries piggyback like the gen summaries: the
+            # handler collected this request's finished spans (and its
+            # own in-flight HTTP span) so the coordinator can adopt
+            # them into one cluster-wide trace tree
+            spans = getattr(req, "_span_collect", None)
+            if spans is not None:
+                spans = list(spans)
+                hs = getattr(req, "_trace_span", None)
+                if hs is not None and hs.sampled:
+                    spans.append(hs.to_dict())
+                trailer["spans"] = spans
+            return res, trailer
+
+        def internal_query(req, args):
+            if req.headers.get("Content-Type", "").split(";")[0].strip() \
+                    == qwire.CONTENT_TYPE:
+                # PTPUQRY1 binary wire (docs/cluster.md "Internal query
+                # wire").  A node pinned to internal-wire=json answers
+                # 415 — the capability-mismatch signal the client's
+                # negotiation downgrades on (it retries as JSON).
+                from ..api import UnsupportedMediaTypeError
+                if cluster.internal_wire != qwire.WIRE_BIN1:
+                    raise UnsupportedMediaTypeError(
+                        "internal query wire is pinned to json")
+                try:
+                    calls_wire, shards, nreq = qwire.decode_request(
+                        req.body)
+                except qwire.FrameError as e:
+                    from ..api import ApiError
+                    raise ApiError(f"bad query wire request: {e}")
+                res, trailer = _exec_multi(req, args["index"],
+                                           calls_wire, shards)
+                payload, nresp = qwire.encode_response(res, trailer)
+                if cluster.stats is not None:
+                    cluster.stats.count("cluster.wire_bytes_rx",
+                                        len(req.body))
+                    cluster.stats.count("cluster.wire_bytes_tx",
+                                        len(payload))
+                    cluster.stats.count("cluster.wire_frames",
+                                        nreq + nresp)
+                return qwire.CONTENT_TYPE, payload
             body = req.json()
             shards = body.get("shards")
             if "calls" in body:
-                calls = [call_from_wire(c) for c in body["calls"]]
-                t0 = time.perf_counter()
-                res = cluster.api.executor.execute(
-                    args["index"], Query(calls), shards or [],
-                    translate=False)
-                out = {"results": [result_to_wire(r) for r in res],
-                       "execS": time.perf_counter() - t0,
-                       # post-execution gen summary: lets the coordinator
-                       # key its cross-node result-cache entries to the
-                       # data this answer was computed from
-                       "gens": list(gen_summary(cluster.holder,
-                                                args["index"]))}
-                # quarantined fragments answered as EMPTY: piggyback the
-                # count so the coordinator's response says so
-                # (utils/degraded.py, docs/robustness.md)
-                nq = len(cluster.holder.quarantined_fragments(
-                    args["index"]))
-                if nq:
-                    out["quarantined"] = nq
-                # admission depth piggyback (parallel/routing.py): every
-                # answered sub-query refreshes the coordinator's load
-                # view of this node, like the gen summaries above
-                out["load"] = cluster.local_load()
-                # span summaries piggyback like the gen summaries: the
-                # handler collected this request's finished spans (and
-                # its own in-flight HTTP span) so the coordinator can
-                # adopt them into one cluster-wide trace tree
-                spans = getattr(req, "_span_collect", None)
-                if spans is not None:
-                    spans = list(spans)
-                    hs = getattr(req, "_trace_span", None)
-                    if hs is not None and hs.sampled:
-                        spans.append(hs.to_dict())
-                    out["spans"] = spans
+                res, trailer = _exec_multi(req, args["index"],
+                                           body["calls"], shards)
+                out = {"results": [result_to_wire(r) for r in res]}
+                out.update(trailer)
                 return out
             call = call_from_wire(body["call"])
             result = cluster._local_exec(args["index"], call, shards or [])

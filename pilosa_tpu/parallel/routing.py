@@ -491,13 +491,17 @@ class ReadRouter:
                 "peers": peers,
             }
         # breaker state rides along so one surface answers "why was this
-        # peer skipped"
+        # peer skipped"; wire mode likewise answers "which internal
+        # query wire would the next fan-out to this peer speak"
+        # (docs/cluster.md "Internal query wire")
         for nid, info in out["peers"].items():
             node = self.cluster.by_id.get(nid)
             if node is not None:
                 info["breakerOpen"] = \
                     self.cluster.client.breaker_open(node.host)
                 info["state"] = node.state
+                info["wire"] = \
+                    self.cluster.client.peer_wire_mode(node.host)
         return out
 
     def peer_states(self) -> list[tuple[str, dict]]:

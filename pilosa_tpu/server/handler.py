@@ -20,7 +20,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from .. import __version__
-from ..api import API, ApiError, ConflictError, DisallowedError, NotFoundError
+from ..api import (
+    API, ApiError, ConflictError, DisallowedError, NotFoundError,
+    UnsupportedMediaTypeError,
+)
 from ..storage.fragment import FragmentQuarantinedError
 from ..utils import degraded
 from ..utils import explain as qexplain
@@ -1142,6 +1145,12 @@ class _HandlerClass(BaseHTTPRequestHandler):
         except DisallowedError as e:
             status = 400
             self._send(400, {"error": str(e)})
+        except UnsupportedMediaTypeError as e:
+            # internal-wire negotiation: a binary /internal/query POST
+            # to a node pinned to json — the caller downgrades the peer
+            # and retries over the JSON wire (docs/cluster.md)
+            status = 415
+            self._send(415, {"error": str(e)})
         except ClientAbort:
             # the client hung up mid-response: already counted, nothing
             # left to send — just let the connection close

@@ -154,6 +154,14 @@ class Config:
     # answers with what it has, and the response's degraded object names
     # exactly the missing shards/nodes.  Off = such reads fail loudly.
     partial_results: bool = False
+    # Internal query wire (docs/cluster.md "Internal query wire"):
+    # "bin1" (default) speaks the PTPUQRY1 CRC-framed binary transport
+    # on /internal/query — roaring-packed row segments, packed numpy
+    # scalar arrays — negotiating per peer via the /status `wire`
+    # capability list and downgrading to JSON on refusal; "json"
+    # restores the pre-binary JSON envelope exactly, both served and
+    # spoken.
+    internal_wire: str = "bin1"
     # -- elastic serving (docs/cluster.md "Read routing & rebalancing") ----
     # Read fan-out replica policy: "primary" pins reads to the jump-hash
     # primary (the pre-routing behavior, byte-for-byte), "round-robin"
@@ -321,6 +329,7 @@ class Config:
             "PILOSA_TPU_HEDGE_DELAY_MS": ("hedge_delay_ms", float),
             "PILOSA_TPU_PARTIAL_RESULTS": (
                 "partial_results", lambda s: s == "true"),
+            "PILOSA_TPU_INTERNAL_WIRE": ("internal_wire", str),
             "PILOSA_TPU_READ_ROUTING": ("read_routing", str),
             "PILOSA_TPU_RESIDENCY_ROUTING": (
                 "residency_routing", lambda s: s != "false"),
@@ -395,6 +404,7 @@ class Config:
             "hedge-reads": "hedge_reads",
             "hedge-delay-ms": "hedge_delay_ms",
             "partial-results": "partial_results",
+            "internal-wire": "internal_wire",
             "read-routing": "read_routing",
             "residency-routing": "residency_routing",
             "balancer": "balancer",
@@ -515,6 +525,7 @@ class Server:
                 hot_shard_threshold=self.config.hot_shard_threshold,
                 hedge_reads=self.config.hedge_reads,
                 hedge_delay_ms=self.config.hedge_delay_ms,
+                internal_wire=self.config.internal_wire,
             )
             # fan-out failure events (cluster.fanout_failed) land in the
             # server log like the whole-query fallbacks

@@ -52,11 +52,17 @@ python -m pilosa_tpu.analysis
 # byte-identical to explain-off, and the fleet rollup must agree with
 # per-node /debug/vars golden — silent drift in any of them turns the
 # operable-cluster story into a lie.
+# The internal-wire suite (docs/cluster.md "Internal query wire") is a
+# correctness gate, not a perf test: the binary PTPUQRY1 framing must
+# answer byte-identically to the JSON wire — including under
+# mixed-version 415 downgrade — and reject every corrupted or truncated
+# frame; a codec bug here silently corrupts every cluster read.
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' -p no:cacheprovider \
     tests/test_durability.py tests/test_crash.py tests/test_containers.py \
     tests/test_device_obs.py tests/test_ingest.py tests/test_wholequery.py \
     tests/test_routing.py tests/test_churn.py \
-    tests/test_events.py tests/test_explain.py tests/test_cluster_obs.py
+    tests/test_events.py tests/test_explain.py tests/test_cluster_obs.py \
+    tests/test_qwire.py
 
 # committed bytecode/cache artifacts must never land in the tree (shell
 # stays the right layer for a git-index check)

@@ -83,6 +83,19 @@ def test_bench_smoke_resident_and_budgeted():
     assert ch["hedges"] > 0 and ch["hedge_wins"] > 0
     assert ch["p99_hedged_ms"] < ch["injected_delay_ms"]
     assert ch["p99_hedged_ms"] < ch["p99_unhedged_ms"]
+    # internal-wire leg (docs/cluster.md "Internal query wire"): binary
+    # PTPUQRY1 answered byte-identically to the JSON wire on the same
+    # recorded corpus (asserted in bench.py), the roaring framing
+    # actually shrank sparse results on the wire, and the mixed-version
+    # 415 downgrade fired and answered identically
+    wr = data["wire"]
+    assert wr["answers_identical"] is True
+    assert wr["sparse_wire_bytes_per_q"]["bin1"] \
+        < wr["sparse_wire_bytes_per_q"]["json"]
+    assert wr["sparse_bytes_ratio"] > 1.5
+    assert wr["qps_bin1"] > 0 and wr["qps_json"] > 0
+    assert wr["fallback"]["count"] >= 1
+    assert wr["fallback"]["answers_identical"] is True
     # observability leg (docs/observability.md): profile-off serving
     # stays within 5% of the batching leg (asserted in bench.py) and
     # profile-on returned a populated stage tree + resolvable trace

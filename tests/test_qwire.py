@@ -1,0 +1,393 @@
+"""Binary internal query wire (parallel/qwire.py, docs/cluster.md
+"Internal query wire").
+
+Three layers: codec round-trips (every result shape, packed-vs-raw
+segment choice, the endianness tag), frame robustness (the PR 6/PR 9
+fuzz pattern — one flipped bit at EVERY byte offset and truncation at
+EVERY length must be rejected, never mis-merged, on request AND response
+streams), and cluster negotiation (binary steady-state with counters, a
+mixed-version fan-out where a JSON-pinned peer triggers the 415
+downgrade path with byte-identical merged answers, and the
+internal-wire=json knob restoring the JSON envelope)."""
+
+import json
+import socket
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import SHARD_WIDTH, SHARD_WORDS
+from pilosa_tpu.executor.results import (
+    FieldRow, GroupCount, Pair, RowIdentifiers, RowResult, ValCount,
+)
+from pilosa_tpu.parallel import qwire
+from pilosa_tpu.parallel.cluster import result_to_wire
+from pilosa_tpu.server.server import Config, Server
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _seg(rng, nwords=30):
+    s = np.zeros(SHARD_WORDS, dtype=np.uint32)
+    idx = rng.choice(SHARD_WORDS, nwords, replace=False)
+    s[idx] = rng.integers(1, 2**32, nwords, dtype=np.uint64).astype(
+        np.uint32)
+    return s
+
+
+# -- codec round-trips -------------------------------------------------------
+
+
+def test_roundtrip_every_result_shape(rng):
+    """Every result shape survives encode->decode with the same meaning
+    as the JSON wire (compared through result_to_wire, the codec the
+    coordinator's reduce actually consumes)."""
+    results = [
+        RowResult({0: _seg(rng), 5: _seg(rng, 400)}, attrs={"a": 1}),
+        RowResult({}),
+        ValCount(42, 7),
+        ValCount(2.5, 3),          # float val (Avg-style)
+        ValCount(None, 0),         # absent val
+        RowIdentifiers(rows=[1, 5, 9]),
+        RowIdentifiers(rows=[], keys=["x", "y"]),
+        [Pair(1, 10), Pair(2, 5)],
+        [Pair(7, 9, "k1"), Pair(8, 4, "k2")],
+        [],                        # empty pairs list
+        [GroupCount([FieldRow("f", 1)], 3)],  # rides the JSON record
+        123,                       # raw value
+        None,
+    ]
+    trailer = {"execS": 0.01, "gens": [["f", 3]], "quarantined": 1,
+               "load": {"inFlight": 0, "queued": 0}, "spans": []}
+    body, nframes = qwire.encode_response(results, trailer)
+    got, got_trailer, got_n = qwire.decode_response(body)
+    assert got_trailer == trailer
+    assert got_n == nframes == len(results) + 1
+    assert len(got) == len(results)
+    for want, have in zip(results, got):
+        assert result_to_wire(want) == result_to_wire(have)
+
+
+def test_request_roundtrip():
+    calls = [{"name": "Row", "args": {"f": 3}},
+             {"name": "Count", "children": [{"name": "Row"}]}]
+    body = qwire.encode_request(calls, [0, 3, 1 << 40])
+    got_calls, got_shards, nframes = qwire.decode_request(body)
+    assert got_calls == calls
+    assert got_shards == [0, 3, 1 << 40]
+    assert nframes == 2
+    # unpinned (None) shard list survives too
+    _, shards, _ = qwire.decode_request(qwire.encode_request([], None))
+    assert shards is None
+
+
+def test_segment_encoding_choice(rng):
+    """Sparse and run-structured segments travel roaring-packed (bytes
+    scale with cardinality); dense-random segments fall back to raw
+    words — whichever is smaller, decode always exact."""
+    sparse = _seg(rng, 20)
+    enc, blob = qwire.encode_segment(sparse)
+    assert enc == qwire.SEG_PACKED
+    assert len(blob) < SHARD_WORDS * 4 // 50
+    assert np.array_equal(qwire.decode_segment(enc, blob), sparse)
+
+    run = np.zeros(SHARD_WORDS, dtype=np.uint32)
+    run[100:6000] = 0xFFFFFFFF   # Store'd-row shape: long runs
+    enc, blob = qwire.encode_segment(run)
+    assert enc == qwire.SEG_PACKED
+    assert len(blob) < 1024
+    assert np.array_equal(qwire.decode_segment(enc, blob), run)
+
+    dense = rng.integers(0, 2**32, SHARD_WORDS, dtype=np.uint64).astype(
+        np.uint32)
+    enc, blob = qwire.encode_segment(dense)
+    assert enc == qwire.SEG_RAW
+    assert len(blob) == SHARD_WORDS * 4
+    assert np.array_equal(qwire.decode_segment(enc, blob), dense)
+
+
+def test_endianness_tag_rejected(rng):
+    """A packed-array record whose endian tag is not little-endian is
+    rejected loudly (a future big-endian or u64-word peer must never
+    silently mis-merge) — CRC recomputed so ONLY the tag check fires."""
+    body, _ = qwire.encode_response([RowResult({0: _seg(rng)})], {})
+    frames = list(qwire.iter_frames(body))
+    payload = bytearray(bytes(frames[0]))
+    assert payload[0] == qwire.REC_ROW and payload[1] == qwire.ENDIAN_LE
+    payload[1] = 1  # not ENDIAN_LE
+    rebuilt = qwire.MAGIC + qwire.encode_frame(bytes(payload)) \
+        + qwire.encode_frame(bytes(frames[1]))
+    with pytest.raises(qwire.FrameError, match="little-endian"):
+        qwire.decode_response(rebuilt)
+
+
+# -- frame robustness (the PR 6/PR 9 fuzz pattern) ---------------------------
+
+
+def _decoded_request(data):
+    calls, shards, _ = qwire.decode_request(data)
+    return calls, shards
+
+
+def test_request_every_byte_corruption_rejected(rng):
+    """Flip one bit at EVERY byte offset of a request stream and
+    truncate at EVERY length: decode must reject (magic, bounds, CRC,
+    record checks) — never yield a DIFFERENT call batch silently."""
+    body = qwire.encode_request(
+        [{"name": "Row", "args": {"f": int(rng.integers(0, 50))}}],
+        [0, 2, 5])
+    want = _decoded_request(body)
+    for off in range(len(body)):
+        bad = bytearray(body)
+        bad[off] ^= 0x10
+        try:
+            got = _decoded_request(bytes(bad))
+        except qwire.FrameError:
+            continue
+        assert got != want, f"corruption at byte {off} went undetected"
+    for cut in range(len(body)):
+        try:
+            got = _decoded_request(body[:cut])
+        except qwire.FrameError:
+            continue
+        assert got != want, f"truncation to {cut} bytes went undetected"
+
+
+def _decoded_response(data):
+    results, trailer, _ = qwire.decode_response(data)
+    return [result_to_wire(r) for r in results], trailer
+
+
+def test_response_every_byte_corruption_rejected(rng):
+    """Same walk over a response stream carrying a packed row, a
+    valcount, and the trailer.  The REQUIRED trailer frame doubles as
+    the end-of-stream marker, so truncation at a frame boundary (which
+    leaves every remaining frame CRC-clean) is still detected."""
+    body, _ = qwire.encode_response(
+        [RowResult({0: _seg(rng, 8)}), ValCount(9, 2)],
+        {"execS": 0.5, "load": {"inFlight": 1, "queued": 0}})
+    want = _decoded_response(body)
+    for off in range(len(body)):
+        bad = bytearray(body)
+        bad[off] ^= 0x10
+        try:
+            got = _decoded_response(bytes(bad))
+        except qwire.FrameError:
+            continue
+        assert got != want, f"corruption at byte {off} went undetected"
+    for cut in range(len(body)):
+        try:
+            got = _decoded_response(body[:cut])
+        except qwire.FrameError:
+            continue
+        assert got != want, f"truncation to {cut} bytes went undetected"
+
+
+def test_frame_ceiling_and_junk():
+    with pytest.raises(qwire.FrameError, match="magic"):
+        list(qwire.iter_frames(b"NOTMAGIC" + b"\x00" * 16))
+    with pytest.raises(qwire.FrameError):
+        list(qwire.iter_frames(b"PT"))
+    # a corrupted length field far over the ceiling is bounds-rejected
+    # before any allocation
+    huge = qwire.MAGIC + qwire.FRAME.pack(qwire.MAX_FRAME_BYTES + 1, 0)
+    with pytest.raises(qwire.FrameError, match="outside"):
+        list(qwire.iter_frames(huge))
+    # a response with no trailer frame (e.g. severed mid-stream at a
+    # clean frame boundary) is truncation, not success
+    naked = qwire.MAGIC + qwire.encode_frame(
+        qwire.encode_result(ValCount(1, 1)))
+    with pytest.raises(qwire.FrameError, match="trailer"):
+        qwire.decode_response(naked)
+
+
+# -- cluster negotiation (in-process 2-node harness) -------------------------
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _req(port, method, path, data=None):
+    body = None
+    if data is not None:
+        body = data.encode() if isinstance(data, str) \
+            else json.dumps(data).encode()
+    r = urllib.request.Request(
+        f"http://localhost:{port}{path}", method=method, data=body)
+    with urllib.request.urlopen(r, timeout=180) as resp:
+        return json.loads(resp.read())
+
+
+def _mk_cluster(tmp_path, wires):
+    """One server per entry of ``wires`` (each "bin1" or "json")."""
+    ports = _free_ports(len(wires))
+    hosts = [f"localhost:{p}" for p in ports]
+    servers = []
+    for i, (p, w) in enumerate(zip(ports, wires)):
+        cfg = Config(
+            data_dir=str(tmp_path / f"node{i}-{w}"),
+            bind=f"localhost:{p}",
+            node_id=f"node{i}",
+            cluster_hosts=hosts,
+            replica_n=1,
+            anti_entropy_interval=0,
+            internal_wire=w,
+        )
+        srv = Server(cfg)
+        srv.open()
+        servers.append(srv)
+    return servers
+
+
+def _seed_and_query(servers, index="q0"):
+    """Write rows spanning several shards from the coordinator, then
+    return the full public JSON response of a fan-out read (the
+    byte-identity unit: merged rows, counts, TopN).
+
+    Index name matters: placement jump-hashes (index, shard), and "q0"
+    puts shards {0,1} on node1 and {2,3} on node0 of a 2-node ring — so
+    a query from node0 ALWAYS fans out remotely (the wire under test
+    actually carries traffic).  "qi", say, lands all 4 shards on node0
+    and the counters never move."""
+    p = servers[0].port
+    _req(p, "POST", f"/index/{index}", {})
+    _req(p, "POST", f"/index/{index}/field/f", {})
+    pql = "".join(
+        f"Set({c}, f={r})"
+        for r in range(3)
+        for c in range(r, 4 * SHARD_WIDTH, SHARD_WIDTH // 2 + 7 * (r + 1)))
+    _req(p, "POST", f"/index/{index}/query", pql)
+    return _req(p, "POST", f"/index/{index}/query",
+                "Row(f=0)Count(Union(Row(f=0), Row(f=1)))"
+                "TopN(f, n=3)Count(Intersect(Row(f=1), Row(f=2)))")
+
+
+def _close_all(servers):
+    for s in servers:
+        try:
+            s.close()
+        except Exception:
+            pass
+
+
+def test_binary_steady_state_counters_and_mode(tmp_path):
+    """Both nodes bin1: fan-out rides the binary wire (frames counted,
+    bytes counted both directions), /status advertises the capability,
+    and /debug/vars shows the per-peer wire mode."""
+    servers = _mk_cluster(tmp_path, ["bin1", "bin1"])
+    try:
+        out = _seed_and_query(servers)
+        assert out["results"]
+        st = _req(servers[0].port, "GET", "/status")
+        assert st["wire"] == ["json", "bin1"]
+        stats = servers[0].stats
+        assert stats.count_value("cluster.wire_frames") > 0
+        assert stats.count_value("cluster.wire_bytes_tx") > 0
+        assert stats.count_value("cluster.wire_bytes_rx") > 0
+        assert stats.count_value("cluster.wire_fallback") == 0
+        dv = _req(servers[0].port, "GET", "/debug/vars")
+        peers = dv["cluster"]["routing"]["peers"]
+        assert {p["wire"] for p in peers.values()} == {"bin1"}
+    finally:
+        _close_all(servers)
+
+
+def test_json_knob_restores_json_wire(tmp_path):
+    """internal-wire=json on every node: no binary frames ever, the
+    capability list omits bin1, and queries serve exactly as before."""
+    servers = _mk_cluster(tmp_path, ["json", "json"])
+    try:
+        out = _seed_and_query(servers)
+        assert out["results"]
+        st = _req(servers[0].port, "GET", "/status")
+        assert st["wire"] == ["json"]
+        stats = servers[0].stats
+        assert stats.count_value("cluster.wire_frames") == 0
+        assert stats.count_value("cluster.wire_fallback") == 0
+        # bytes are still counted on the JSON wire so bin1-vs-json
+        # compare from the same counters
+        assert stats.count_value("cluster.wire_bytes_tx") > 0
+    finally:
+        _close_all(servers)
+
+
+def test_mixed_version_downgrade_byte_identical(tmp_path):
+    """A bin1 coordinator fanning out to a JSON-pinned peer: the first
+    binary POST is refused 415, the peer is latched to JSON (counted +
+    journaled), the SAME request retries as JSON — and the merged public
+    answer is byte-identical to an all-JSON cluster's."""
+    servers = _mk_cluster(tmp_path, ["bin1", "json"])
+    try:
+        out = _seed_and_query(servers)
+        stats = servers[0].stats
+        assert stats.count_value("cluster.wire_fallback") >= 1
+        ev = _req(servers[0].port, "GET", "/debug/events")
+        kinds = [e["event"] for e in ev["events"]]
+        assert "wire.downgrade" in kinds
+        # latched: the peer's effective wire mode is now json
+        dv = _req(servers[0].port, "GET", "/debug/vars")
+        peers = dv["cluster"]["routing"]["peers"]
+        assert "json" in {p["wire"] for p in peers.values()}
+        # the downgrade costs ONE retry, then stays on JSON
+        fallbacks = stats.count_value("cluster.wire_fallback")
+        again = _req(servers[0].port, "POST", "/index/q0/query",
+                     "Row(f=0)Count(Union(Row(f=0), Row(f=1)))"
+                     "TopN(f, n=3)Count(Intersect(Row(f=1), Row(f=2)))")
+        assert stats.count_value("cluster.wire_fallback") == fallbacks
+        assert again == out
+    finally:
+        _close_all(servers)
+
+    ref = _mk_cluster(tmp_path, ["json", "json"])
+    try:
+        want = _seed_and_query(ref)
+    finally:
+        _close_all(ref)
+    assert json.dumps(out, sort_keys=True) == json.dumps(
+        want, sort_keys=True)
+
+
+def test_probe_folds_capability_and_recovers(tmp_path):
+    """The /status probe fold clears a peer's JSON latch once it
+    advertises bin1 again (rolling-upgrade recovery), and folds a
+    json-only advertisement into the pre-dispatch choice."""
+    servers = _mk_cluster(tmp_path, ["bin1", "bin1"])
+    try:
+        cl = servers[0].cluster
+        host1 = cl.nodes[1].host
+        # simulate an earlier refusal
+        cl.client._wire_downgrade(host1, 415)
+        assert cl.client.peer_wire_mode(host1) == "json"
+        cl.probe_peers()  # peer advertises bin1 -> latch cleared
+        assert cl.client.peer_wire_mode(host1) == "bin1"
+        # a peer advertising json-only is never even attempted on binary
+        cl.client.note_peer_wire(host1, ["json"])
+        assert cl.client.peer_wire_mode(host1) == "json"
+    finally:
+        _close_all(servers)
+
+
+def test_internal_wire_config_plumbing(tmp_path, monkeypatch):
+    """Knob rides Config/env/TOML; an invalid value fails loudly."""
+    assert Config().internal_wire == "bin1"
+    monkeypatch.setenv("PILOSA_TPU_INTERNAL_WIRE", "json")
+    assert Config.from_env().internal_wire == "json"
+    toml = tmp_path / "c.toml"
+    toml.write_text('internal-wire = "json"\n')
+    assert Config.from_toml(str(toml)).internal_wire == "json"
+    from pilosa_tpu.parallel.cluster import Cluster, ClusterError
+    with pytest.raises(ClusterError, match="internal_wire"):
+        Cluster("node0", ["localhost:1"], internal_wire="bin2")
