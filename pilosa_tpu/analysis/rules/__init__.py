@@ -8,6 +8,7 @@ from . import (  # noqa: F401
     failpoints,
     metrics_docs,
     router_bypass,
+    tenant_attribution,
     thread_context,
     tier1_legs,
     traced_closure,

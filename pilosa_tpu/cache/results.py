@@ -29,6 +29,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from ..utils import tenant as qtenant
 from ..utils.locks import make_lock
 
 
@@ -117,19 +118,31 @@ class ResultCache:
     """(scope…, gens…) -> results list; thread-safe, LRU by bytes.
 
     ``limit_bytes == 0`` disables lookups and fills entirely (the bare-
-    Executor default; the server wires ``result-cache-mb`` through)."""
+    Executor default; the server wires ``result-cache-mb`` through).
 
-    def __init__(self, limit_bytes: int = 0, stats=None):
+    ``tenant_quota_bytes`` (``tenant-cache-quota-mb``; 0 = no per-tenant
+    cap) bounds any ONE tenant's resident bytes: a fill that pushes its
+    tenant over quota evicts that tenant's own oldest entries first, and
+    global byte pressure also lands on over-quota tenants' entries before
+    anyone else's — one tenant's churn cannot flush its neighbors
+    (docs/robustness.md "Tenant isolation")."""
+
+    def __init__(self, limit_bytes: int = 0, stats=None,
+                 tenant_quota_bytes: int = 0):
         self.limit_bytes = limit_bytes
+        self.tenant_quota_bytes = tenant_quota_bytes
         self.stats = stats
         self._lock = make_lock("result-cache")
-        self._entries: OrderedDict = OrderedDict()  # key -> (results, nbytes)
+        # key -> (results, nbytes, tenant)
+        self._entries: OrderedDict = OrderedDict()
         self._by_query: dict = {}  # qkey -> full key (stale-entry sweep)
+        self._tenant_bytes: dict[str, int] = {}
         self.resident_bytes = 0
         self.hits = 0
         self.misses = 0
         self.evicts = 0
         self.invalidates = 0
+        self.quota_evicts = 0
 
     def _count(self, name: str):
         if self.stats is not None:
@@ -148,30 +161,85 @@ class ResultCache:
                     else "resultcache.miss")
         return list(entry[0]) if entry is not None else None
 
-    def fill(self, qkey, key, results):
+    def _unlink(self, key) -> int:
+        """Pop ``key`` and keep the byte ledgers consistent; returns the
+        freed bytes (0 when absent).  Caller holds the lock."""
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            return 0
+        _r, nb, t = entry
+        self.resident_bytes -= nb
+        if t is not None:
+            left = self._tenant_bytes.get(t, 0) - nb
+            if left > 0:
+                self._tenant_bytes[t] = left
+            else:
+                self._tenant_bytes.pop(t, None)
+        return nb
+
+    def _evict_tenant_lru(self, tenant, keep) -> bool:
+        """Evict ``tenant``'s least-recently-used entry (quota
+        pressure), never ``keep`` — the entry being filled; a lone
+        over-quota entry rides transiently over, so a quota smaller
+        than one answer still caches that answer.  Caller holds the
+        lock."""
+        for k, entry in self._entries.items():  # LRU order
+            if entry[2] == tenant and k != keep:
+                self._unlink(k)
+                self.evicts += 1
+                self.quota_evicts += 1
+                self._count("resultcache.evict")
+                if self.stats is not None:
+                    self.stats.count(f"tenant.{tenant}.quota_evict")
+                qtenant.REGISTRY.note_quota_evict(tenant, entry[1])
+                return True
+        return False
+
+    def _global_victim(self):
+        """Global-pressure victim key: the oldest entry of any
+        OVER-QUOTA tenant if one exists, else the plain LRU head.
+        Caller holds the lock."""
+        if self.tenant_quota_bytes > 0:
+            over = {t for t, b in self._tenant_bytes.items()
+                    if b > self.tenant_quota_bytes}
+            if over:
+                for k, entry in self._entries.items():
+                    if entry[2] in over:
+                        return k
+        return next(iter(self._entries))
+
+    def fill(self, qkey, key, results, tenant=None):
         """Insert under ``key``; ``qkey`` is the generation-free prefix
-        used to eagerly drop a superseded (stale-gen) entry."""
+        used to eagerly drop a superseded (stale-gen) entry.  ``tenant``
+        charges the entry's bytes to that tenant's quota (None falls
+        back to the ambient request tenant)."""
         nbytes = _result_bytes(results)
         if nbytes > self.limit_bytes:
             return  # larger than the whole budget: never admit
+        if tenant is None:
+            tenant = qtenant.current_or_none()
         results = _host_results(results)
         with self._lock:
             old_key = self._by_query.get(qkey)
             if old_key is not None and old_key != key:
-                old = self._entries.pop(old_key, None)
-                if old is not None:
-                    self.resident_bytes -= old[1]
+                if self._unlink(old_key):
                     self.invalidates += 1
                     self._count("resultcache.invalidate")
             self._by_query[qkey] = key
-            prev = self._entries.pop(key, None)
-            if prev is not None:
-                self.resident_bytes -= prev[1]
-            self._entries[key] = (results, nbytes)
+            self._unlink(key)
+            self._entries[key] = (results, nbytes, tenant)
             self.resident_bytes += nbytes
+            if tenant is not None:
+                self._tenant_bytes[tenant] = \
+                    self._tenant_bytes.get(tenant, 0) + nbytes
+                # per-tenant quota: the filling tenant's own LRU pays
+                while self.tenant_quota_bytes > 0 \
+                        and self._tenant_bytes.get(tenant, 0) \
+                        > self.tenant_quota_bytes \
+                        and self._evict_tenant_lru(tenant, key):
+                    pass
             while self.resident_bytes > self.limit_bytes and self._entries:
-                _k, (_r, nb) = self._entries.popitem(last=False)
-                self.resident_bytes -= nb
+                self._unlink(self._global_victim())
                 self.evicts += 1
                 self._count("resultcache.evict")
             # _by_query is bookkeeping only; prune dangling pointers so it
@@ -186,6 +254,7 @@ class ResultCache:
             n = len(self._entries)
             self._entries.clear()
             self._by_query.clear()
+            self._tenant_bytes.clear()
             self.resident_bytes = 0
         return n
 
@@ -195,8 +264,11 @@ class ResultCache:
                 "entries": len(self._entries),
                 "bytes": self.resident_bytes,
                 "limitBytes": self.limit_bytes,
+                "tenantQuotaBytes": self.tenant_quota_bytes,
                 "hits": self.hits,
                 "misses": self.misses,
                 "evicts": self.evicts,
                 "invalidates": self.invalidates,
+                "quotaEvicts": self.quota_evicts,
+                "tenantBytes": dict(self._tenant_bytes),
             }

@@ -178,6 +178,11 @@ def cmd_ingest(args) -> int:
         for attempt in range(args.max_retries + 1):
             req = urllib.request.Request(url, data=body, method="POST")
             req.add_header("Content-Type", "application/octet-stream")
+            if args.tenant:
+                # explicit tenant token (docs/robustness.md "Tenant
+                # isolation"): the stream rides that tenant's ingest
+                # admission queue instead of the index-derived one
+                req.add_header("X-Pilosa-Tpu-Tenant", args.tenant)
             try:
                 with urllib.request.urlopen(req) as resp:
                     resp.read()
@@ -703,6 +708,10 @@ def main(argv=None) -> int:
                          "stream; 503s resend the whole batch)")
     sp.add_argument("--max-retries", type=int, default=8,
                     help="503 retries per batch before giving up")
+    sp.add_argument("--tenant", default="",
+                    help="explicit tenant token sent as "
+                         "X-Pilosa-Tpu-Tenant (default: the server "
+                         "derives the tenant from the index name)")
     sp.add_argument("files", nargs="*")
     sp.set_defaults(fn=cmd_ingest)
 

@@ -532,6 +532,8 @@ class Executor:
 
     def _execute_stages(self, index_name: str, query, shards, translate,
                         check_current, qprof) -> list[Any]:
+        from ..utils import degraded
+        from ..utils import tenant as qtenant
         stats = self.stats
         # Result-cache lookup FIRST (before even the parse): node-local
         # entries key on the query text (an AST keys on its normalized
@@ -589,10 +591,12 @@ class Executor:
                     # entry records that the PREPARED cache drove it
                     qexplain.note("plan", {"mode": "prepared",
                                            "shards": len(shards or ())})
-                    if ckey is not None:
+                    if ckey is not None and not degraded.is_degraded():
                         # prepared entries exist only for Count/Sum/TopN
-                        # templates — read-only by construction
-                        cache.fill(qkey, ckey, out)
+                        # templates — read-only by construction; a
+                        # quarantined-degraded answer stays uncached
+                        cache.fill(qkey, ckey, out,
+                                   tenant=qtenant.current_or_none())
                     return out
                 stats.count("query.prepared.miss")
                 if out is not None:
@@ -666,10 +670,14 @@ class Executor:
         if translate and self.translator.needs_translation(index_name):
             results = self.translator.translate_results(
                 index_name, query.calls, results)
-        if ckey is not None:
+        if ckey is not None and not degraded.is_degraded():
+            # degraded answers (quarantined fragments serving empty rows,
+            # or shards lost under partialResults) are never memoized: a
+            # healthy repeat must recompute
             from ..cache.results import query_is_readonly
             if query_is_readonly(query):
-                cache.fill(qkey, ckey, results)
+                cache.fill(qkey, ckey, results,
+                           tenant=qtenant.current_or_none())
         return results
 
     # -- batched multi-call execution --------------------------------------

@@ -55,6 +55,7 @@ from ..utils import degraded
 from ..utils import events
 from ..utils import explain as qexplain
 from ..utils import profile as qprof
+from ..utils import tenant as qtenant
 from ..utils.deadline import DEADLINE_HEADER, current as current_ctx
 from ..utils.faults import FAULTS
 from ..utils.locks import make_lock, make_rlock
@@ -484,6 +485,12 @@ class InternalClient:
         trace_hdr = GLOBAL_TRACER.inject()
         if trace_hdr is not None:
             headers[TRACE_HEADER] = trace_hdr
+        # Tenant propagation (docs/robustness.md "Tenant isolation"):
+        # only an EXPLICIT token forwards — a derived identity is
+        # re-derived from the index on the peer, same answer, no header.
+        tenant_hdr = qtenant.header_value()
+        if tenant_hdr is not None:
+            headers[qtenant.TENANT_HEADER] = tenant_hdr
         if headers_extra:
             headers.update(headers_extra)
 
@@ -1005,7 +1012,8 @@ class Cluster:
                  hot_shard_threshold: float = 4.0,
                  hedge_reads: bool = True,
                  hedge_delay_ms: float = 0.0,
-                 internal_wire: str = qwire.WIRE_BIN1):
+                 internal_wire: str = qwire.WIRE_BIN1,
+                 tenant_hedge_budget: float = 0.0):
         if internal_wire not in (qwire.WIRE_JSON, qwire.WIRE_BIN1):
             raise ClusterError(
                 f"internal_wire must be one of "
@@ -1119,6 +1127,12 @@ class Cluster:
         # derives the delay from the router's EWMA RTT.
         self.hedge_reads = bool(hedge_reads)
         self.hedge_delay_ms = float(hedge_delay_ms)
+        # Per-tenant hedge token budget (docs/robustness.md "Tenant
+        # isolation"): each speculative duplicate draws a token from the
+        # requesting tenant's bucket; an exhausted bucket reads unhedged
+        # (counted, never an error).  0 (the bare-Cluster default)
+        # disables the budget entirely.
+        self.hedge_budget = qtenant.HedgeBudget(rate=tenant_hedge_budget)
         # structured-event sink (cluster.fanout_failed); the Server
         # wires its logger in, standalone clusters stay silent
         self.logger = None
@@ -1804,18 +1818,21 @@ class Cluster:
         if translator.needs_translation(index):
             results = translator.translate_results(index, query.calls,
                                                    results)
-        if qkey is not None and not degraded.is_partial():
+        if qkey is not None and not degraded.is_degraded():
             # Fill key = lookup-time local state + the peer gen summaries
             # AS OBSERVED by this fan-out's responses.  Only the seen
             # vector is re-read: the responses describe exactly the data
             # the results came from (so the first warm repeat hits),
             # while everything captured at lookup time guarantees a
             # concurrent write's invalidation can never be overwritten.
-            # A PARTIAL answer (shards lost under partialResults) is
-            # never cached: a later healthy repeat must recompute, not
-            # serve the degraded result.
+            # A DEGRADED answer — shards lost under partialResults OR
+            # quarantined fragments answering empty — is never cached: a
+            # later healthy repeat must recompute, not serve the
+            # degraded result (is_partial alone would memoize the
+            # quarantined case).
             cache.fill(qkey, qkey + local_part +
-                       (self._peer_seen_vector(index),), results)
+                       (self._peer_seen_vector(index),), results,
+                       tenant=qtenant.current_or_none())
         return results
 
     @classmethod
@@ -2222,6 +2239,26 @@ class Cluster:
                         hedge_shards = [s for s in fl["shards"]
                                         if s in remaining]
                         if not hedge_shards:
+                            continue
+                        # Per-tenant hedge budget (docs/robustness.md
+                        # "Tenant isolation"): each hedge round draws a
+                        # token from the requesting tenant's bucket; an
+                        # exhausted bucket keeps the read UNHEDGED —
+                        # counted and visible, never an error — so one
+                        # tenant's straggler storm cannot amplify its
+                        # own load onto the fleet.
+                        hedge_tenant = qtenant.current()
+                        if not self.hedge_budget.try_take(hedge_tenant):
+                            stats.count("cluster.hedge_budget_denied")
+                            stats.count(
+                                f"tenant.{hedge_tenant}.hedge_denied")
+                            qtenant.REGISTRY.note_hedge_denied(
+                                hedge_tenant)
+                            qexplain.note("hedges", {
+                                "outcome": "budget_denied",
+                                "tenant": hedge_tenant,
+                                "insteadOf": fl["nid"],
+                                "shards": len(hedge_shards)})
                             continue
                         excl = exclude | {fl["nid"]}
                         # cheapest shape first: ONE replica owning the

@@ -57,6 +57,17 @@ def summarize_vars(v: dict) -> dict:
     adm = (v.get("admission") or {}).get("public") or {}
     cl = v.get("cluster") or {}
     quarantined = v.get("storage", {}).get("quarantined") or []
+    # tenant isolation plane: the per-tenant qps/p99/shed/quota columns
+    # each node publishes (docs/robustness.md "Tenant isolation")
+    tenants = {}
+    for name, row in (v.get("tenants") or {}).items():
+        tenants[name] = {
+            "qps": float(row.get("qps") or 0.0),
+            "p99Ms": row.get("p99Ms"),
+            "shed": int(row.get("shed") or 0),
+            "hedgeDenied": int(row.get("hedgeDenied") or 0),
+            "quotaEvicts": int(row.get("quotaEvicts") or 0),
+        }
     return {
         "queries": int(hq.get("count") or 0),
         "p50Ms": round(hq["p50"] * 1e3, 3) if hq.get("p50") else None,
@@ -81,6 +92,7 @@ def summarize_vars(v: dict) -> dict:
         "admissionInUse": int(adm.get("inUse") or 0),
         "admissionWaiting": int(adm.get("waiting") or 0),
         "overlayEpoch": int((cl.get("overlay") or {}).get("epoch") or 0),
+        "tenants": tenants,
     }
 
 
@@ -274,6 +286,22 @@ class FleetRollup:
                     if entry.get("error"):
                         info["error"] = entry["error"]
                 nodes[n.id] = info
+            # fleet-wide per-tenant rollup: qps/shed/hedge/quota summed
+            # across nodes, p99 as the worst node's (a tenant's tail is
+            # wherever it is slowest)
+            fleet_tenants: dict[str, dict] = {}
+            for info in nodes.values():
+                for name, row in (info.get("tenants") or {}).items():
+                    agg = fleet_tenants.setdefault(name, {
+                        "qps": 0.0, "p99Ms": None, "shed": 0,
+                        "hedgeDenied": 0, "quotaEvicts": 0})
+                    agg["qps"] = round(agg["qps"] + row["qps"], 3)
+                    agg["shed"] += row["shed"]
+                    agg["hedgeDenied"] += row["hedgeDenied"]
+                    agg["quotaEvicts"] += row["quotaEvicts"]
+                    if row.get("p99Ms") is not None:
+                        agg["p99Ms"] = max(agg["p99Ms"] or 0.0,
+                                           row["p99Ms"])
             timeline = sorted(self._timeline,
                               key=lambda e: (e.get("wall", 0),
                                              e.get("seq", 0)))
@@ -286,6 +314,7 @@ class FleetRollup:
                 "overlayEpoch": cluster.overlay_epoch,
                 "epoch": cluster.epoch,
                 "nodes": nodes,
+                "tenants": fleet_tenants,
                 "timeline": timeline,
             }
         out["hotShards"] = cluster.balancer.snapshot()

@@ -29,13 +29,25 @@ from ..utils import degraded
 from ..utils import explain as qexplain
 from ..utils.locks import make_lock
 from ..utils import profile as qprof
+from ..utils import tenant as qtenant
 from ..utils.deadline import (DEADLINE_HEADER, DeadlineExceeded,
                               QueryContext, activate)
 from ..utils.tracing import (GLOBAL_TRACER, PROBE_HEADER, TRACE_HEADER,
                              parse_trace_header)
 from ..executor import RowResult, ValCount, RowIdentifiers
 from ..executor.results import GroupCount, Pair
-from .admission import AdmissionRejected
+from .admission import AdmissionRejected, decorrelated_retry_after
+
+
+def _ingest_retry_after(req) -> float:
+    """Computed Retry-After for ingest-side 503s: the ingest pool's
+    pressure-scaled, jittered backoff (a fixed constant re-stampedes a
+    synchronized client cohort); bare test handlers without a pool still
+    get the jitter."""
+    adm = getattr(req, "admission_ingest", None)
+    if adm is not None:
+        return adm.retry_after()
+    return decorrelated_retry_after(1.0)
 
 
 def serialize_result(r) -> object:
@@ -169,6 +181,12 @@ def build_debug_vars(api: API, server=None) -> dict:
             "public": server.admission.snapshot(),
             "internal": server.admission_internal.snapshot(),
         }
+    # tenant isolation plane (docs/robustness.md "Tenant isolation"):
+    # per-tenant qps/p50/p99/shed/hedge-denied/quota columns — the
+    # registry is process-wide, so bare-API servers report it too
+    tenants = qtenant.REGISTRY.snapshot()
+    if tenants:
+        out["tenants"] = tenants
     if server is not None and getattr(server, "cluster",
                                       None) is not None:
         out["breakers"] = server.cluster.client.breaker_snapshot()
@@ -437,7 +455,8 @@ def build_router(api: API, server=None) -> Router:
                 # the owner's backlog is full: propagate the 503 so the
                 # client backs off the whole stream (frames are
                 # idempotent — resending is safe)
-                raise AdmissionRejected(str(e), retry_after=1)
+                raise AdmissionRejected(
+                    str(e), retry_after=_ingest_retry_after(req))
 
         try:
             while True:
@@ -449,7 +468,7 @@ def build_router(api: API, server=None) -> Router:
                         req.stats.count("ingest.rejected")
                     raise AdmissionRejected(
                         "ingest backlog over high-water; retry",
-                        retry_after=1)
+                        retry_after=_ingest_retry_after(req))
                 item = reader.next_frame()
                 if item is None:
                     break
@@ -535,7 +554,7 @@ def build_router(api: API, server=None) -> Router:
             req.close_connection = True
             raise AdmissionRejected(
                 "ingest flush did not complete in time; retry",
-                retry_after=1)
+                retry_after=_ingest_retry_after(req))
         return {"frames": frames, "records": records,
                 "forwarded": fwd_records}
 
@@ -994,6 +1013,7 @@ class _HandlerClass(BaseHTTPRequestHandler):
         status = 200
         prof = None
         erec = None
+        self._tenant = None
         want_profile = False
         want_explain = False
         trace_out = None
@@ -1046,50 +1066,72 @@ class _HandlerClass(BaseHTTPRequestHandler):
                     prof = qprof.QueryProfile()
                 if want_explain or slow_on:
                     erec = qexplain.ExplainRecord()
+            # Tenant identity (docs/robustness.md "Tenant isolation"):
+            # derived for every GATED route — index name by default,
+            # explicit X-Pilosa-Tpu-Tenant token override.  A malformed
+            # token is a TenantError (ValueError) -> clean 400 below,
+            # BEFORE any admission/stat carries the garbage as a label.
+            tenant = None
+            tenant_explicit = False
+            if gate is not None:
+                tenant, tenant_explicit = qtenant.derive(
+                    self.headers.get(qtenant.TENANT_HEADER),
+                    args.get("index"))
+                self._tenant = tenant
             adm = self.admission if gate == "query" else \
                 self.admission_internal if gate == "internal" else \
                 self.admission_ingest if gate == "ingest" else None
             admitted = False
-            if adm is not None:
-                # slot wait is the first profile stage: under overload
-                # it IS the latency story
-                with (prof.stage("admission") if prof is not None
-                      else _NULL_CTX):
-                    adm.acquire()  # raises AdmissionRejected -> 503
-                admitted = True
-            try:
-                # /internal/ continuations collect this request's
-                # finished spans so /internal/query can piggyback them
-                # back to the coordinator (cluster.py reads these attrs)
-                collect = [] if (tid is not None
-                                 and parsed.path.startswith("/internal/")) \
-                    else None
-                with activate(ctx):
-                    if ctx is not None:
-                        ctx.check("admission")
-                    # background requests with no inbound trace must not
-                    # root new sampled traces: probe cadence x peers
-                    # would continuously evict real query traces from
-                    # the bounded span ring
-                    root_sampled = sampled if tid is not None \
-                        else (False if background else None)
-                    with GLOBAL_TRACER.span(
-                            f"{method} {parsed.path}", trace_id=tid,
-                            parent_id=parent_id, sampled=root_sampled,
-                            collect=collect) as span, \
-                            qprof.activate(prof), \
-                            qexplain.activate(erec):
-                        self._trace_span = span
-                        self._span_collect = collect
-                        trace_out = span.trace_id
-                        if "index" in args:
-                            # searchable root-span tags: /debug/traces
-                            # ?index=... filters on them
-                            span.set_tag("index", args["index"])
-                        out = fn(self, args)
-            finally:
-                if admitted:
-                    adm.release()
+            with qtenant.activate(tenant, tenant_explicit):
+                if adm is not None:
+                    # slot wait is the first profile stage: under
+                    # overload it IS the latency story
+                    with (prof.stage("admission") if prof is not None
+                          else _NULL_CTX):
+                        # raises AdmissionRejected -> 503
+                        waited = adm.acquire(tenant=tenant)
+                    admitted = True
+                    if erec is not None:
+                        # EXPLAIN names the tenant queue the query
+                        # waited in and for how long
+                        erec.note("admission", {
+                            "tenant": tenant, "pool": adm.name,
+                            "queuedMs": round(waited * 1e3, 3)})
+                try:
+                    # /internal/ continuations collect this request's
+                    # finished spans so /internal/query can piggyback
+                    # them back to the coordinator (cluster.py reads
+                    # these attrs)
+                    collect = [] if (tid is not None
+                                     and parsed.path.startswith(
+                                         "/internal/")) \
+                        else None
+                    with activate(ctx):
+                        if ctx is not None:
+                            ctx.check("admission")
+                        # background requests with no inbound trace must
+                        # not root new sampled traces: probe cadence x
+                        # peers would continuously evict real query
+                        # traces from the bounded span ring
+                        root_sampled = sampled if tid is not None \
+                            else (False if background else None)
+                        with GLOBAL_TRACER.span(
+                                f"{method} {parsed.path}", trace_id=tid,
+                                parent_id=parent_id, sampled=root_sampled,
+                                collect=collect) as span, \
+                                qprof.activate(prof), \
+                                qexplain.activate(erec):
+                            self._trace_span = span
+                            self._span_collect = collect
+                            trace_out = span.trace_id
+                            if "index" in args:
+                                # searchable root-span tags:
+                                # /debug/traces?index=... filters on them
+                                span.set_tag("index", args["index"])
+                            out = fn(self, args)
+                finally:
+                    if admitted:
+                        adm.release()
             if isinstance(out, tuple):
                 ctype, payload = out
                 self._send_raw(200, ctype, payload.encode()
@@ -1189,6 +1231,13 @@ class _HandlerClass(BaseHTTPRequestHandler):
             self.stats.timing("http.request", dur_s, exemplar=exemplar)
             if gate == "query":
                 self.stats.timing("http.query", dur_s, exemplar=exemplar)
+        # per-tenant accounting: latency/qps/error columns for the
+        # /debug/vars "tenants" table and the fleet rollup
+        tenant = getattr(self, "_tenant", None)
+        if tenant is not None and gate == "query":
+            qtenant.REGISTRY.note_request(tenant, dur_s, status)
+            if self.stats is not None:
+                self.stats.timing(f"tenant.{tenant}.query", dur_s)
         slog = self.slowlog
         if (gate == "query" and slog is not None and slog.enabled
                 and dur_s >= slog.threshold_s):

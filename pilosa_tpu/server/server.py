@@ -162,6 +162,29 @@ class Config:
     # restores the pre-binary JSON envelope exactly, both served and
     # spoken.
     internal_wire: str = "bin1"
+    # -- tenant isolation (docs/robustness.md "Tenant isolation") ----------
+    # Weighted-fair per-tenant admission queues + tenant-first shedding.
+    # Off collapses the wait queues back to the single pre-isolation
+    # FIFO (reject-the-arrival shedding) for differential benches.
+    tenant_isolation: bool = True
+    # Relative admission weights, "name:weight,...": e.g.
+    # "analytics:4,batch:1" gives analytics 4 slot grants per batch
+    # grant under contention.  Unlisted tenants weigh 1.
+    tenant_weights: str = ""
+    # Burst allowance: an idle tenant banks up to weight*burst slot
+    # credits, so a short burst rides through un-queued-on before
+    # deficit round-robin paces it.
+    tenant_burst: float = 8.0
+    # Per-tenant byte cap (MB) inside the result cache AND the HBM
+    # residency budget: a tenant filling past it evicts its OWN entries
+    # first, and global pressure prefers over-quota tenants.  0 = no
+    # per-tenant cap (the global budgets still apply).
+    tenant_cache_quota_mb: int = 0
+    # Per-tenant hedge token budget (tokens/second, equal burst): each
+    # speculative read draws one token from the requesting tenant's
+    # bucket; an exhausted bucket reads unhedged (counted, never an
+    # error).  0 = unlimited hedging.
+    tenant_hedge_budget: float = 32.0
     # -- elastic serving (docs/cluster.md "Read routing & rebalancing") ----
     # Read fan-out replica policy: "primary" pins reads to the jump-hash
     # primary (the pre-routing behavior, byte-for-byte), "round-robin"
@@ -330,6 +353,14 @@ class Config:
             "PILOSA_TPU_PARTIAL_RESULTS": (
                 "partial_results", lambda s: s == "true"),
             "PILOSA_TPU_INTERNAL_WIRE": ("internal_wire", str),
+            "PILOSA_TPU_TENANT_ISOLATION": (
+                "tenant_isolation", lambda s: s != "false"),
+            "PILOSA_TPU_TENANT_WEIGHTS": ("tenant_weights", str),
+            "PILOSA_TPU_TENANT_BURST": ("tenant_burst", float),
+            "PILOSA_TPU_TENANT_CACHE_QUOTA_MB": (
+                "tenant_cache_quota_mb", int),
+            "PILOSA_TPU_TENANT_HEDGE_BUDGET": (
+                "tenant_hedge_budget", float),
             "PILOSA_TPU_READ_ROUTING": ("read_routing", str),
             "PILOSA_TPU_RESIDENCY_ROUTING": (
                 "residency_routing", lambda s: s != "false"),
@@ -405,6 +436,11 @@ class Config:
             "hedge-delay-ms": "hedge_delay_ms",
             "partial-results": "partial_results",
             "internal-wire": "internal_wire",
+            "tenant-isolation": "tenant_isolation",
+            "tenant-weights": "tenant_weights",
+            "tenant-burst": "tenant_burst",
+            "tenant-cache-quota-mb": "tenant_cache_quota_mb",
+            "tenant-hedge-budget": "tenant_hedge_budget",
             "read-routing": "read_routing",
             "residency-routing": "residency_routing",
             "balancer": "balancer",
@@ -469,6 +505,11 @@ class Server:
             if self.config.host_stage_mb > 0
             else (0 if self.config.host_stage_mb == 0 else None))
         HOST_STAGE_BUDGET.shrink_to_limit()
+        # tenant isolation (docs/robustness.md "Tenant isolation"):
+        # per-tenant residency quota on the HBM tier, same process-wide
+        # most-recent-Server-wins convention as the limits above
+        DEFAULT_BUDGET.tenant_quota_bytes = \
+            max(self.config.tenant_cache_quota_mb, 0) << 20
         # Durability knobs are process-wide module flags on the fragment
         # codec (same most-recent-Server-wins convention as the budgets):
         # they govern file OPENS, which happen under holder.open() below.
@@ -526,6 +567,9 @@ class Server:
                 hedge_reads=self.config.hedge_reads,
                 hedge_delay_ms=self.config.hedge_delay_ms,
                 internal_wire=self.config.internal_wire,
+                tenant_hedge_budget=(
+                    self.config.tenant_hedge_budget
+                    if self.config.tenant_isolation else 0.0),
             )
             # fan-out failure events (cluster.fanout_failed) land in the
             # server log like the whole-query fallbacks
@@ -551,6 +595,9 @@ class Server:
         # the memory budgets (most recent Server's config wins)
         self.api.executor.result_cache.limit_bytes = \
             max(self.config.result_cache_mb, 0) << 20
+        self.api.executor.result_cache.tenant_quota_bytes = \
+            (max(self.config.tenant_cache_quota_mb, 0) << 20) \
+            if self.config.tenant_isolation else 0
         from .. import cache as _cache_pkg
         _cache_pkg.rank.RANK_REBUILD_ROWS = max(
             self.config.rank_rebuild_rows, 0)
@@ -569,12 +616,17 @@ class Server:
         # sizing, is what prevents coordinator fan-out from deadlocking
         # behind public traffic.
         from .admission import AdmissionController
+        from ..utils.tenant import parse_weights
+        tenant_weights = parse_weights(self.config.tenant_weights)
+        tenant_kw = dict(weights=tenant_weights,
+                         burst=self.config.tenant_burst,
+                         fair=self.config.tenant_isolation)
         self.admission = AdmissionController(
             self.config.max_queries, self.config.queue_timeout,
-            stats=self.stats, name="public")
+            stats=self.stats, name="public", **tenant_kw)
         self.admission_internal = AdmissionController(
             self.config.max_queries, self.config.queue_timeout,
-            stats=self.stats, name="internal")
+            stats=self.stats, name="internal", **tenant_kw)
         # Third pool for streaming ingest (docs/ingest.md): sustained
         # writes must not occupy read slots, and forwarded-ingest
         # handling on a peer must not queue behind ITS public writes
@@ -582,7 +634,7 @@ class Server:
         # deadlock-free).
         self.admission_ingest = AdmissionController(
             self.config.max_queries, self.config.queue_timeout,
-            stats=self.stats, name="ingest")
+            stats=self.stats, name="ingest", **tenant_kw)
         # Group committer: the write path's flush/merge engine.
         from ..ingest import GroupCommitter
         self.committer = GroupCommitter(

@@ -29,14 +29,24 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Callable
 
+from ..utils import tenant as qtenant
 from ..utils.locks import make_rlock
 
 
 class DeviceBudget:
-    def __init__(self, limit_bytes: int | None = None):
+    def __init__(self, limit_bytes: int | None = None,
+                 tenant_quota_bytes: int = 0):
         self.limit_bytes = limit_bytes  # None = unlimited (accounting only)
-        # key -> [nbytes, evict callback, pin count, compressed bytes]
+        # Per-tenant residency cap (``tenant-cache-quota-mb``; 0 = off):
+        # a tenant staging past it evicts ITS OWN unpinned-coldest
+        # entries, and global pressure prefers over-quota tenants'
+        # entries — one index's working set cannot flush the fleet's
+        # (docs/robustness.md "Tenant isolation").
+        self.tenant_quota_bytes = tenant_quota_bytes
+        # key -> [nbytes, evict cb, pin count, compressed bytes, tenant]
         self._entries: OrderedDict[tuple, list] = OrderedDict()
+        self._tenant_bytes: dict[str, int] = {}
+        self.quota_evictions = 0
         self._total = 0
         self._compressed = 0  # portion of _total held in packed form
         self._peak = 0
@@ -66,6 +76,27 @@ class DeviceBudget:
     def resident_bytes(self) -> int:
         return self._total
 
+    def _pop_locked(self, key: tuple) -> list:
+        """Pop ``key`` keeping the byte ledgers (total, compressed,
+        per-tenant) consistent.  Caller must hold self._lock."""
+        e = self._entries.pop(key)
+        self._total -= e[0]
+        self._compressed -= e[3]
+        t = e[4]
+        if t is not None:
+            left = self._tenant_bytes.get(t, 0) - e[0]
+            if left > 0:
+                self._tenant_bytes[t] = left
+            else:
+                self._tenant_bytes.pop(t, None)
+        return e
+
+    def _over_quota_locked(self) -> set:
+        if self.tenant_quota_bytes <= 0:
+            return set()
+        return {t for t, b in self._tenant_bytes.items()
+                if b > self.tenant_quota_bytes}
+
     def _evict_lru_locked(self, incoming: int) -> list[Callable[[], None]]:
         """Pop LRU entries until ``incoming`` more bytes fit the limit;
         returns their callbacks for the caller to run OUTSIDE the lock
@@ -73,26 +104,59 @@ class DeviceBudget:
         one).  Caller must hold self._lock.
 
         Pinned entries are NEVER popped — an in-flight dispatch or a
-        prefetch holds them — so eviction takes the unpinned-coldest;
-        when everything left is pinned, the budget runs transiently
+        prefetch holds them — so eviction takes the unpinned-coldest,
+        preferring entries of tenants OVER their residency quota (the
+        over-quota tenant pays for the pressure it created); when
+        everything left is pinned, the budget runs transiently
         over-limit instead of corrupting in-flight work."""
         to_evict: list[Callable[[], None]] = []
         if self.limit_bytes is None:
             return to_evict
         while self._entries and self._total + incoming > self.limit_bytes:
             victim = None
+            over = self._over_quota_locked()
+            if over:
+                for key, e in self._entries.items():  # LRU -> MRU order
+                    if e[2] == 0 and e[4] in over:
+                        victim = key
+                        self.quota_evictions += 1
+                        break
+            if victim is None:
+                for key, e in self._entries.items():
+                    if e[2] == 0:
+                        victim = key
+                        break
+            if victim is None:
+                break  # all pinned: admit over-limit
+            e = self._pop_locked(victim)
+            self.evictions += 1
+            self.evicted_bytes += e[0]
+            to_evict.append(e[1])
+        return to_evict
+
+    def _evict_tenant_locked(self, tenant, keep: tuple
+                             ) -> list[Callable[[], None]]:
+        """Per-tenant quota pressure: pop ``tenant``'s unpinned-coldest
+        entries until it fits its quota, never popping ``keep`` (the
+        entry being registered) — a lone over-quota entry runs
+        transiently over, like the all-pinned case.  Caller holds
+        self._lock; returns callbacks to run outside it."""
+        to_evict: list[Callable[[], None]] = []
+        if self.tenant_quota_bytes <= 0 or tenant is None:
+            return to_evict
+        while self._tenant_bytes.get(tenant, 0) > self.tenant_quota_bytes:
+            victim = None
             for key, e in self._entries.items():  # LRU -> MRU order
-                if e[2] == 0:
+                if e[4] == tenant and e[2] == 0 and key != keep:
                     victim = key
                     break
             if victim is None:
-                break  # all pinned: admit over-limit
-            freed, cb, _, comp = self._entries.pop(victim)
-            self._total -= freed
-            self._compressed -= comp
+                break
+            e = self._pop_locked(victim)
             self.evictions += 1
-            self.evicted_bytes += freed
-            to_evict.append(cb)
+            self.quota_evictions += 1
+            self.evicted_bytes += e[0]
+            to_evict.append(e[1])
         return to_evict
 
     def _run_evictions(self, to_evict: list[Callable[[], None]]):
@@ -110,7 +174,7 @@ class DeviceBudget:
                     self.evict_errors += 1
 
     def register(self, key: tuple, nbytes: int, evict: Callable[[], None],
-                 compressed_bytes: int = 0):
+                 compressed_bytes: int = 0, tenant: str | None = None):
         """Account ``nbytes`` under ``key``; ``evict`` drops the owner's
         reference when called.  Evicts LRU entries first if needed (never
         evicting the incoming entry itself).  Re-registering an existing
@@ -118,22 +182,35 @@ class DeviceBudget:
         user still holds pinned).  ``compressed_bytes`` is the portion of
         ``nbytes`` held as packed container streams rather than dense
         tensors (docs/memory-budget.md "Compressed residency") — it
-        splits the resident gauge, not the accounting."""
+        splits the resident gauge, not the accounting.  ``tenant``
+        charges the bytes against that tenant's residency quota (None
+        falls back to the ambient request tenant)."""
+        if tenant is None:
+            tenant = qtenant.current_or_none()
         with self._lock:
-            old = self._entries.pop(key, None)
             pins = 0
-            if old is not None:
-                self._total -= old[0]
-                self._compressed -= old[3]
-                pins = old[2]
+            if key in self._entries:
+                pins = self._pop_locked(key)[2]
             evicted0 = self.evicted_bytes
             to_evict = self._evict_lru_locked(nbytes)
             freed = self.evicted_bytes - evicted0
-            self._entries[key] = [nbytes, evict, pins, compressed_bytes]
+            self._entries[key] = [nbytes, evict, pins, compressed_bytes,
+                                  tenant]
             self._total += nbytes
             self._compressed += compressed_bytes
+            if tenant is not None:
+                self._tenant_bytes[tenant] = \
+                    self._tenant_bytes.get(tenant, 0) + nbytes
+                quota0 = self.evicted_bytes
+                quota_evict = self._evict_tenant_locked(tenant, key)
+                quota_freed = self.evicted_bytes - quota0
+                to_evict.extend(quota_evict)
+            else:
+                quota_evict, quota_freed = [], 0
             self._peak = max(self._peak, self._total)
             self.upload_bytes += nbytes
+        if quota_evict:
+            qtenant.REGISTRY.note_quota_evict(tenant, quota_freed)
         self._note_pressure(freed, len(to_evict))
         self._run_evictions(to_evict)
 
@@ -207,10 +284,8 @@ class DeviceBudget:
 
     def unregister(self, key: tuple):
         with self._lock:
-            e = self._entries.pop(key, None)
-            if e is not None:
-                self._total -= e[0]
-                self._compressed -= e[3]
+            if key in self._entries:
+                self._pop_locked(key)
 
     def stats(self) -> dict:
         with self._lock:
@@ -230,6 +305,9 @@ class DeviceBudget:
                 "prefetchHits": self.prefetch_hits,
                 "prefetchMisses": self.prefetch_misses,
                 "pinnedBytes": pinned_bytes,
+                "tenantQuotaBytes": self.tenant_quota_bytes,
+                "quotaEvictions": self.quota_evictions,
+                "tenantBytes": dict(self._tenant_bytes),
             }
 
 

@@ -85,6 +85,17 @@ def is_partial() -> bool:
     return bool(acc is not None and acc["missingShards"])
 
 
+def is_degraded() -> bool:
+    """Did the current request degrade in ANY way — lost shards OR
+    quarantined fragments?  This is the result-cache fill guard:
+    ``is_partial()`` alone would memoize a quarantined-degraded answer
+    (empty rows standing in for poisoned fragments) and keep serving it
+    after the fragments heal."""
+    acc = _collector.get()
+    return bool(acc is not None and (acc["missingShards"]
+                                     or acc["quarantinedFragments"]))
+
+
 def to_response(acc: dict) -> dict | None:
     """The wire ``degraded`` object for a finished collector, or None
     when the request was not degraded at all."""

@@ -169,18 +169,26 @@ class Tracer:
         ``name`` is given, runs fn under a span of that name — so work
         fanned out to pools parents correctly instead of starting orphan
         traces."""
+        from . import tenant as qtenant
         ctx = self.capture()
-        if ctx is None:
+        # the tenant identity rides the same pool boundary: an outbound
+        # fan-out RPC in a worker thread must still know WHOSE request
+        # it serves (header forwarding, hedge budgets — utils/tenant.py)
+        tctx = qtenant.context()
+        if ctx is None and tctx is None:
             return fn
 
         def run(*args, **kwargs):
-            with self.attach(ctx):
-                if name is None:
+            with qtenant.activate(*(tctx or (None, False))):
+                if ctx is None:
                     return fn(*args, **kwargs)
-                with self.span(name) as s:
-                    for k, v in span_tags.items():
-                        s.set_tag(k, v)
-                    return fn(*args, **kwargs)
+                with self.attach(ctx):
+                    if name is None:
+                        return fn(*args, **kwargs)
+                    with self.span(name) as s:
+                        for k, v in span_tags.items():
+                            s.set_tag(k, v)
+                        return fn(*args, **kwargs)
 
         return run
 

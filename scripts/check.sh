@@ -57,12 +57,19 @@ python -m pilosa_tpu.analysis
 # answer byte-identically to the JSON wire — including under
 # mixed-version 415 downgrade — and reject every corrupted or truncated
 # frame; a codec bug here silently corrupts every cluster read.
+# The tenant-isolation suite (docs/robustness.md "Tenant isolation")
+# rides for the same class of reason: weighted-fair admission and
+# tenant-first shedding sit on an exactness contract (admitted answers
+# are byte-identical with the plane on or off) plus an attribution
+# contract (a hostile flood's sheds land on the hostile tenant) — and
+# the degraded-result cache guard it pins prevents a partial answer
+# from being memoized as the real one.
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' -p no:cacheprovider \
     tests/test_durability.py tests/test_crash.py tests/test_containers.py \
     tests/test_device_obs.py tests/test_ingest.py tests/test_wholequery.py \
     tests/test_routing.py tests/test_churn.py \
     tests/test_events.py tests/test_explain.py tests/test_cluster_obs.py \
-    tests/test_qwire.py
+    tests/test_qwire.py tests/test_tenant.py
 
 # committed bytecode/cache artifacts must never land in the tree (shell
 # stays the right layer for a git-index check)

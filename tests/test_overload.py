@@ -275,7 +275,9 @@ def test_admission_overflow_returns_503_under_burst(tmp_path):
             urllib.request.urlopen(req, timeout=10)
         except urllib.error.HTTPError as e:
             assert e.code == 503
-            assert int(e.headers["Retry-After"]) >= 1
+            # computed + jittered backoff: fractional seconds, floored
+            # at 1 (cli ingest parses floats)
+            assert float(e.headers["Retry-After"]) >= 1
         slow.join(timeout=30)
     finally:
         srv.close()
