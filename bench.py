@@ -2556,6 +2556,126 @@ def run_compressed_smoke(rng) -> dict:
         ex.close()
 
 
+# Restart-leg worker (docs/warmup.md).  Inline rather than
+# tests/crash_worker.py because the crash harness pins its Config — the
+# restart leg needs the warm-start knobs and its own traffic shape.
+# "seed" serves steady traffic, flushes the corpus, then parks until the
+# parent kill -9s it mid-serving; "restart" boots on the same data dir,
+# waits out the warming phase, and times the first query end-to-end.
+_RESTART_WORKER = r'''
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+mode, data_dir = sys.argv[1], sys.argv[2]
+from pilosa_tpu.server.server import Server, Config
+s = Server(Config(data_dir=data_dir, bind="localhost:0",
+                  timeseries_interval=0, metric_poll_interval=0,
+                  anti_entropy_interval=0))
+s.open()
+if mode == "seed":
+    s.api.create_index("ri")
+    s.api.create_field("ri", "f")
+    s.api.query("ri", "".join(f"Set({c}, f={r})"
+                              for r in range(4) for c in range(60)))
+    for _ in range(3):
+        s.api.query("ri", "Count(Row(f=1))")
+        s.api.query("ri", "Row(f=2)")
+        s.api.query("ri", "TopN(f, n=3)")
+    s.warmup.recorder.flush(s.warmup.corpus)
+    print("SEEDED", flush=True)
+    time.sleep(600)  # the parent kill -9s us here: no clean close
+else:
+    t0 = time.monotonic()
+    while s.warmup.warming() and time.monotonic() - t0 < 120:
+        time.sleep(0.01)
+    st = s.warmup.status()
+    t1 = time.perf_counter()
+    first = s.api.query("ri", "Count(Row(f=1))")
+    first_ms = (time.perf_counter() - t1) * 1e3
+    assert first == [60], first
+    steady = []
+    for _ in range(5):
+        t2 = time.perf_counter()
+        s.api.query("ri", "Count(Row(f=1))")
+        steady.append((time.perf_counter() - t2) * 1e3)
+    s.close()
+    print(json.dumps({"warmup": st, "first_ms": round(first_ms, 2),
+                      "steady_ms": round(min(steady), 2)}), flush=True)
+'''
+
+
+def run_restart_smoke(rng) -> dict:
+    """Restart leg of --smoke (docs/warmup.md): seed a server with
+    steady traffic, kill -9 it mid-serving, restart on the same data
+    dir (warm: durable corpus + persistent compile cache survive), then
+    restart again with both wiped (cold baseline).  The CPU smoke
+    asserts the qualitative invariants — the warm restart replayed the
+    corpus with ZERO retraces and its first query beats the cold
+    restart's; the acceptance ratios (warm first-query p99 within ~2x
+    steady state and >=5x better than cold) are judged on real
+    hardware."""
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="ptpu-restart-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def worker(mode):
+        return subprocess.Popen(
+            [sys.executable, "-c", _RESTART_WORKER, mode, tmp],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env)
+
+    try:
+        seed = worker("seed")
+        line = seed.stdout.readline().strip()
+        if line != "SEEDED":
+            _, err = seed.communicate(timeout=30)
+            raise AssertionError(f"seed worker failed: {err[-2000:]}")
+        seed.kill()  # SIGKILL mid-serving: the crash-harness discipline
+        seed.wait(timeout=30)
+        assert os.path.exists(os.path.join(tmp, "signatures.log")), \
+            "kill -9 lost the corpus: periodic flush never landed"
+
+        warm_raw, warm_err = worker("restart").communicate(timeout=300)
+        assert warm_raw.strip(), f"warm restart died: {warm_err[-2000:]}"
+        warm = json.loads(warm_raw.strip().splitlines()[-1])
+        wst = warm["warmup"]
+        assert wst["replayed"] >= 1, \
+            f"warm restart replayed nothing: {wst}"
+        assert wst["errors"] == 0, f"warm replay errored: {wst}"
+        assert wst["retracesDuringWarm"] == 0, \
+            f"retraces during warm replay: {wst}"
+
+        # cold baseline: no corpus, no compiled bytes
+        os.unlink(os.path.join(tmp, "signatures.log"))
+        shutil.rmtree(os.path.join(tmp, ".compile-cache"),
+                      ignore_errors=True)
+        cold_raw, cold_err = worker("restart").communicate(timeout=300)
+        assert cold_raw.strip(), f"cold restart died: {cold_err[-2000:]}"
+        cold = json.loads(cold_raw.strip().splitlines()[-1])
+        assert cold["warmup"]["replayed"] == 0, cold["warmup"]
+        assert warm["first_ms"] < cold["first_ms"], \
+            (f"warm first query ({warm['first_ms']} ms) not faster than "
+             f"cold ({cold['first_ms']} ms)")
+        return {
+            "replayed": wst["replayed"],
+            "planned": wst["planned"],
+            "retraces_during_warm": wst["retracesDuringWarm"],
+            "saved_compile_s": wst["savedCompileS"],
+            "warm_first_ms": warm["first_ms"],
+            "cold_first_ms": cold["first_ms"],
+            "steady_ms": warm["steady_ms"],
+            "warm_vs_cold": round(cold["first_ms"]
+                                  / max(warm["first_ms"], 1e-9), 1),
+            "warm_vs_steady": round(warm["first_ms"]
+                                    / max(warm["steady_ms"], 1e-9), 1),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_smoke():
     """--smoke: seconds-scale end-to-end exercise of the resident AND the
     budgeted/streaming query paths on tiny shard counts — wired as a
@@ -2636,6 +2756,7 @@ def run_smoke():
     out["observability"] = run_observability_smoke(
         np.random.default_rng(SEED + 5),
         baseline_qps=out["http_batch"]["qps_on"])
+    out["restart"] = run_restart_smoke(np.random.default_rng(SEED + 14))
     out["total_s"] = round(time.perf_counter() - t_start, 2)
     print(json.dumps(out))
 

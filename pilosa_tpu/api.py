@@ -80,6 +80,10 @@ class API:
         self.holder = holder
         self.cluster = cluster  # None = single-node
         self.stats = stats if stats is not None else StatsClient()
+        # Warm-start coordinator (warmup/replayer.py), injected by the
+        # Server; None (bare API) means no warming phase — /status
+        # reports READY immediately, the pre-warmup behavior.
+        self.warmup = None
         self.executor = Executor(
             holder, use_mesh=use_mesh, stats=self.stats,
             dispatch_batch=dispatch_batch,
@@ -383,8 +387,14 @@ class API:
 
     def status(self) -> dict:
         self._validate("Status")
+        # warm-start phase (docs/warmup.md): while the AOT replayer is
+        # warming, this node advertises WARMING — peers' probe folds and
+        # read routers treat it as not-READY, so no traffic lands on a
+        # cold process; clustered nodes ALSO carry it in their local
+        # node state (the Server flips it at warmup completion)
+        warming = self.warmup is not None and self.warmup.warming()
         nodes = [{"id": "node0", "uri": "", "isCoordinator": True,
-                  "state": "READY"}]
+                  "state": "WARMING" if warming else "READY"}]
         state = STATE_NORMAL
         epoch = 0
         out = {}
@@ -422,6 +432,10 @@ class API:
             "quarantinedFragments": len(quarantined),
             "degraded": bool(quarantined),
         }
+        out["warming"] = warming
+        out["phase"] = "warming" if warming else "ready"
+        if self.warmup is not None:
+            out["warmup"] = self.warmup.status()
         return out
 
     def info(self) -> dict:

@@ -463,11 +463,21 @@ def cmd_top(args) -> int:
                   f" / {bud.get('denseBytes', 0) // mb}MB dense"
                   f" / {bud.get('pinnedBytes', 0) // mb}MB pinned)  "
                   f"evictions/s {evs:.2f}")
+            # compile-s/interval: the ring's device.compile delta —
+            # deploys are visibly cheap (warm) or visibly not (cold)
+            comp_s = last.get("compileSDelta", 0.0)
             print(f"   device: compiles {comp.get('compiles', 0)}  "
                   f"retraces {retr}{flag}  "
+                  f"compile-s/int {comp_s:.2f}  "
                   f"launches {lau.get('launches', 0)}  "
                   f"padding {100 * lau.get('paddingWasteRatio', 0):.1f}%  "
                   f"decode peak {lau.get('decodePeakBytes', 0) // mb}MB")
+            warm = v.get("warmup") or {}
+            if warm.get("phase") == "warming":
+                print(f"   WARMING: {warm.get('replayed', 0)}"
+                      f"/{warm.get('planned', 0)} replayed  "
+                      f"errors {warm.get('errors', 0)}  "
+                      f"budget {warm.get('budgetS', 0)}s")
             # per-peer routing load (docs/cluster.md "Read routing &
             # rebalancing"): EWMA RTT, in-flight depth, breaker state
             routing = (v.get("cluster") or {}).get("routing") or {}
@@ -558,6 +568,13 @@ max-op-n = 10000
 #                          # (length+CRC framed JSON records)
 # batch-temp-mb = 4096     # per-launch batch-temp workspace for fused
 #                          # [B, rows, W] row_counts/TopN device temps
+# warm start (docs/warmup.md)
+# compile-cache-dir = ""   # persistent XLA compile cache; "" =
+#                          # <data-dir>/.compile-cache, "off" disables
+# compile-cache-mb = 256   # cache size bound, LRU-pruned; 0 = unbounded
+# warmup-top-n = 32        # corpus signatures replayed before READY,
+#                          # 0 = no warmup replay
+# warmup-budget-s = 30     # wall-clock budget for the warmup replay
 
 # elastic serving (docs/cluster.md "Read routing & rebalancing")
 # read-routing = "loaded"  # or "primary" (pin to jump-hash primary),

@@ -472,6 +472,9 @@ class Executor:
         # Server injects its Logger so wholequery.fallback events land in
         # the server log; None (engine/bench standalone) stays silent.
         self.logger = None
+        # Warm-start corpus recorder (warmup/corpus.py), injected by the
+        # Server like the logger; None (bare executors) records nothing.
+        self.warm_recorder = None
         self.wq_requests = 0
         self.wq_fallbacks = 0
         self.wq_last_fallback = ""
@@ -535,6 +538,9 @@ class Executor:
         from ..utils import degraded
         from ..utils import tenant as qtenant
         stats = self.stats
+        # warm-start corpus (warmup/corpus.py) records by query TEXT —
+        # the only replayable identity across restarts
+        qtext = query if isinstance(query, str) else None
         # Result-cache lookup FIRST (before even the parse): node-local
         # entries key on the query text (an AST keys on its normalized
         # repr), the pinned shard set, and the index's fragment
@@ -574,6 +580,9 @@ class Executor:
                             "schemaEpoch": ckey[6],
                             "attrEpoch": ckey[7]}})
                 if out is not None:
+                    # result-cache entries exist only for read-only
+                    # queries (the fill sites gate on it)
+                    self._warm_note(index_name, qtext)
                     return out
         if isinstance(query, str):
             if translate and self.prepared is not None:
@@ -597,6 +606,7 @@ class Executor:
                         # quarantined-degraded answer stays uncached
                         cache.fill(qkey, ckey, out,
                                    tenant=qtenant.current_or_none())
+                    self._warm_note(index_name, qtext)
                     return out
                 stats.count("query.prepared.miss")
                 if out is not None:
@@ -678,7 +688,16 @@ class Executor:
             if query_is_readonly(query):
                 cache.fill(qkey, ckey, results,
                            tenant=qtenant.current_or_none())
+        if read_only:
+            self._warm_note(index_name, qtext)
         return results
+
+    def _warm_note(self, index_name: str, qtext):
+        """Feed one successfully served read-only string query to the
+        warm-start corpus recorder (no-op on bare executors)."""
+        rec = self.warm_recorder
+        if rec is not None and qtext is not None:
+            rec.note(index_name, qtext)
 
     # -- batched multi-call execution --------------------------------------
 
@@ -868,9 +887,12 @@ class Executor:
                     (extra["field"], extra.get("view", _STD))))
             mats.append(params_mat)
         out = self._wq_dispatch(index, shards, tuple(nodes), mats)
+        if self.warm_recorder is not None:
+            self.warm_recorder.note_sig(out.sig)
         from ..utils import explain as qexplain
         qexplain.note("plan", {
             "mode": "wholequery", "program": out.sig,
+            "compile": "cold" if out.compiled else "warm",
             "nodes": [n.kind for n in nodes],
             "shards": len(shards)})
         mesh = self.mesh_exec
@@ -988,6 +1010,8 @@ class Executor:
             unit_nodes.append((lo, len(nodes)))
 
         out = self._wq_dispatch(index, shards, tuple(nodes), mats)
+        if self.warm_recorder is not None:
+            self.warm_recorder.note_sig(out.sig)
         from ..utils import explain as qexplain
         qexplain.note("plan", {
             "mode": "wholequery",
@@ -995,6 +1019,9 @@ class Executor:
             # compile registry and launch ledger record, so the explain
             # record cross-checks the ledger (None = empty launch)
             "program": out.sig,
+            # warm: served from a cached/persistent-cache executable;
+            # cold: this request paid a trace+compile (docs/warmup.md)
+            "compile": "cold" if out.compiled else "warm",
             "nodes": [n.kind for n in nodes],
             "calls": len(calls), "shards": len(shards)})
         for u, (lo, hi) in zip(units, unit_nodes):

@@ -65,6 +65,12 @@ from .placement import Placement
 
 NODE_READY = "READY"
 NODE_DOWN = "DOWN"
+# Alive but replaying its warmup corpus (docs/warmup.md): probes fold a
+# peer's advertised warming phase here, so every `state == NODE_READY`
+# gate (read routing, AE, broadcast, repair donors) automatically keeps
+# traffic off a cold process.  Warming is NOT counted by _update_state —
+# a warming peer never flips the cluster DEGRADED.
+NODE_WARMING = "WARMING"
 
 
 def _wall_stamp() -> float: return time.time()  # display-only wall clock
@@ -1273,8 +1279,15 @@ class Cluster:
                 self._note_probe_failure(n, err)
                 continue
             n.probe_fails = 0
-            n.state = NODE_READY
-            if was_down:
+            # a peer replaying its warmup corpus advertises warming on
+            # /status; treat it as alive-but-not-READY so routing and
+            # repair skip it until its replay finishes (docs/warmup.md)
+            prev = n.state
+            n.state = NODE_WARMING if st.get("warming") else NODE_READY
+            if prev != NODE_READY and n.state == NODE_READY:
+                # node.up marks ENTERING SERVICE: a restarted peer that
+                # comes back warming emits it when the warmup finishes,
+                # not when its socket first answers
                 events.emit("node.up", peer=n.id)
             # fold the probe's piggybacked gen summaries into the result-
             # cache registry: writes that entered the cluster through
@@ -1371,6 +1384,16 @@ class Cluster:
             return
         down = any(n.state == NODE_DOWN for n in self.nodes)
         self.state = STATE_DEGRADED if down else STATE_NORMAL
+
+    def set_local_warming(self, warming: bool):
+        """Flip the LOCAL node's advertised state between WARMING and
+        READY (docs/warmup.md).  The Server calls this around the AOT
+        warmup replay; peers additionally fold the /status ``warming``
+        flag on their probe cadence, so both the local node_statuses
+        and the fleet's routers see the phase."""
+        n = self.by_id.get(self.node_id)
+        if n is not None and n.state != NODE_DOWN:
+            n.state = NODE_WARMING if warming else NODE_READY
 
     def _mark_down(self, node_id: str):
         n = self.by_id.get(node_id)

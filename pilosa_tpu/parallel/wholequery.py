@@ -141,9 +141,10 @@ class WholeOut:
     carries the host-assembly facts the finalizers need (per-group
     shard lists, fragment-less shards, actual batch rows)."""
 
-    __slots__ = ("parts", "meta", "sig")
+    __slots__ = ("parts", "meta", "sig", "compiled")
 
-    def __init__(self, parts, meta, sig: str | None = None):
+    def __init__(self, parts, meta, sig: str | None = None,
+                 compiled: bool = False):
         self.parts = parts
         self.meta = meta
         # compiled program signature (devobs.sig_of of the executable
@@ -151,6 +152,10 @@ class WholeOut:
         # record), surfaced on the request thread for the EXPLAIN plan
         # section; None for the no-live-groups empty launch
         self.sig = sig
+        # True when THIS launch traced+compiled (a cold program); the
+        # EXPLAIN plan section surfaces it as plan: warm|cold so a
+        # post-deploy compile is visible per request (docs/warmup.md)
+        self.compiled = compiled
 
     def slice_batch(self, program, node_lo: list[int], node_b: list[int]):
         """A fused launch's per-ticket view: slice every node's batch
@@ -166,7 +171,7 @@ class WholeOut:
             else:
                 parts.append([arr[lo:lo + b] for arr in self.parts[ni]])
             meta.append(m)
-        return WholeOut(parts, meta, self.sig)
+        return WholeOut(parts, meta, self.sig, self.compiled)
 
 
 class _InstrumentedWhole:
@@ -431,7 +436,11 @@ class WholeQueryRunner:
         with _DISPATCH_LOCK:
             flat_out = fn(mats_dev, *flat_all, _launch_meta=launch_meta)
         parts = [[flat_out[j] for j in idxs] for idxs in fn.out_index]
-        return WholeOut(parts, meta, fn.sig)
+        # tracing is synchronous on this thread (CompileRegistry's
+        # thread-local protocol), so the flag read here is exactly
+        # whether THIS launch compiled — even when run() executes on the
+        # batcher's dispatcher thread for a fused launch
+        return WholeOut(parts, meta, fn.sig, _devobs.COMPILES.traced())
 
     def _node_meta(self, program, actual_b, live, sched, empty_shards):
         meta = []

@@ -59,6 +59,8 @@ const CHARTS = [
   {title: "compiles &amp; retraces", unit: "/interval",
    series: [{label: "compiles", f: s => s.compilesDelta},
             {label: "retraces", f: s => s.retracesDelta}]},
+  {title: "compile seconds", unit: "s/interval",
+   series: [{label: "compile s", f: s => s.compileSDelta}]},
   {title: "queue depth", unit: "",
    series: [{label: "admission", f: s => s.admissionInUse +
                 s.admissionWaiting},

@@ -240,6 +240,11 @@ def build_debug_vars(api: API, server=None) -> dict:
     from ..utils import devobs
     out["device"] = {"compiles": devobs.COMPILES.totals(),
                      "launches": devobs.LEDGER.aggregates()}
+    # warm start (docs/warmup.md): phase, replay progress, and the
+    # compile-seconds-saved headline for the deploy dashboard
+    warm = getattr(server, "warmup", None) if server is not None else None
+    if warm is not None:
+        out["warmup"] = warm.status()
     # streaming ingest (docs/ingest.md): group-commit backlog, flush
     # counters, and the delta-overlay journal footprint
     committer = getattr(server, "committer", None) \

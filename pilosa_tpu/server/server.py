@@ -282,6 +282,23 @@ class Config:
     # fusing past it.  The decode-workspace-mb pattern, on the batch
     # axis.
     batch_temp_mb: int = 4096
+    # -- warm start (docs/warmup.md) ---------------------------------------
+    # Directory for jax's persistent XLA compilation cache, so a
+    # restarted process reuses executables instead of recompiling.
+    # "" = <data-dir>/.compile-cache; "off" disables the on-disk cache
+    # (the signature corpus + warmup replay still run).
+    compile_cache_dir: str = ""
+    # Size bound (MB) for the compile-cache directory, LRU-pruned by
+    # file mtime at startup and clean shutdown.  0 = unbounded.
+    compile_cache_mb: int = 256
+    # Corpus signatures the AOT warmup replayer replays at startup (the
+    # top-N by traffic) before this node reports READY.  0 disables the
+    # replay (corpus recording still runs for the next restart).
+    warmup_top_n: int = 32
+    # Wall-clock budget (seconds) for the warmup replay: entries beyond
+    # it are skipped (counted) and the node goes READY anyway — warmup
+    # may make READY later, never absent.
+    warmup_budget_s: float = 30.0
     verbose: bool = False
 
     @classmethod
@@ -389,6 +406,10 @@ class Config:
             "PILOSA_TPU_EVENT_LOG": ("event_log", lambda s: s == "true"),
             "PILOSA_TPU_SLOW_LOG_TEXT_MAX": ("slow_log_text_max", int),
             "PILOSA_TPU_BATCH_TEMP_MB": ("batch_temp_mb", int),
+            "PILOSA_TPU_COMPILE_CACHE_DIR": ("compile_cache_dir", str),
+            "PILOSA_TPU_COMPILE_CACHE_MB": ("compile_cache_mb", int),
+            "PILOSA_TPU_WARMUP_TOP_N": ("warmup_top_n", int),
+            "PILOSA_TPU_WARMUP_BUDGET_S": ("warmup_budget_s", float),
         }
         for env, (attr, conv) in env_map.items():
             if env in os.environ:
@@ -463,6 +484,10 @@ class Config:
             "event-log": "event_log",
             "slow-log-text-max": "slow_log_text_max",
             "batch-temp-mb": "batch_temp_mb",
+            "compile-cache-dir": "compile_cache_dir",
+            "compile-cache-mb": "compile_cache_mb",
+            "warmup-top-n": "warmup_top_n",
+            "warmup-budget-s": "warmup_budget_s",
         }
         for key, attr in mapping.items():
             if key in doc:
@@ -680,6 +705,32 @@ class Server:
             self.timeseries = TimeSeriesRing(
                 interval_s=self.config.timeseries_interval,
                 window_s=self.config.timeseries_window)
+        # Warm-start subsystem (docs/warmup.md): persistent XLA compile
+        # cache under the data dir, durable signature corpus, and the
+        # AOT warmup coordinator that replays the corpus before READY.
+        # The compile cache is configured HERE (before any executable
+        # compiles) so even the first queries of a fresh process land
+        # their compilations on disk for the next restart.
+        from .. import warmup as _warmup
+        self._compile_cache_dir = _warmup.resolve_dir(
+            self.config.compile_cache_dir, data_dir)
+        cache_on = False
+        if self._compile_cache_dir is not None:
+            cache_on = _warmup.configure(self._compile_cache_dir)
+            if cache_on:
+                _warmup.prune(self._compile_cache_dir,
+                              self.config.compile_cache_mb)
+        self.warmup = _warmup.WarmupCoordinator(
+            self.api.executor,
+            os.path.join(data_dir, "signatures.log"),
+            top_n=self.config.warmup_top_n,
+            budget_s=self.config.warmup_budget_s,
+            logger=self.logger, stats=self.stats)
+        self.warmup.cache_enabled = cache_on
+        self.api.warmup = self.warmup
+        # the executor feeds the corpus recorder on its success paths
+        # (the logger-injection pattern)
+        self.api.executor.warm_recorder = self.warmup.recorder
         self.httpd = make_http_server(
             self.api, host, port, server=self, tls=tls,
             max_body_bytes=self.config.max_body_mb << 20,
@@ -730,8 +781,20 @@ class Server:
     def open(self):
         """(reference server.go:417 Open)"""
         self.holder.open()
+        # Warm start (docs/warmup.md): load the corpus and decide the
+        # phase AFTER local WAL replay has made the holder queryable
+        # and BEFORE the listener serves /status — a probing peer never
+        # sees a cold node as READY.  The replay itself runs on the
+        # coordinator's own thread, concurrent with the rest of startup
+        # (cluster join, serve loop, monitors).
+        warming = self.warmup.open()
         if self.cluster is not None:
             self.cluster.open(self.api)
+        if warming:
+            if self.cluster is not None:
+                self.cluster.set_local_warming(True)
+            self.warmup.on_ready = self._warmup_ready
+        self.warmup.start()
         t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         t.start()
         self._threads.append(t)
@@ -756,6 +819,13 @@ class Server:
             t.start()
             self._threads.append(t)
         self.diagnostics.open()  # no-op unless an endpoint is configured
+
+    def _warmup_ready(self):
+        """Warmup-replay completion hook: flip the local node's
+        advertised state to READY (peers' probe folds catch up within
+        one health interval)."""
+        if self.cluster is not None:
+            self.cluster.set_local_warming(False)
 
     def collect_runtime_stats(self):
         """Process-level gauges (server.go:813 monitorRuntime + gopsutil;
@@ -1064,6 +1134,14 @@ class Server:
         if self.cluster is not None:
             self.cluster.close()
         self.api.executor.close()
+        # warm start (docs/warmup.md): stop the flush thread, take the
+        # final corpus flush while the compile registry still holds this
+        # run's entries, and LRU-prune the compile cache to its bound
+        self.warmup.close()
+        if self._compile_cache_dir is not None:
+            from .. import warmup as _warmup
+            _warmup.prune(self._compile_cache_dir,
+                          self.config.compile_cache_mb)
         # release this server's on-disk event log handle (the journal
         # itself is process-wide and keeps its ring)
         from ..utils.events import EVENTS

@@ -35,6 +35,10 @@ class Holder:
             idx_path = os.path.join(self.path, name)
             if not os.path.isdir(idx_path):
                 continue
+            # hidden dirs are infrastructure, not indexes (the warm-start
+            # compile cache lives at <data-dir>/.compile-cache)
+            if name.startswith("."):
+                continue
             idx = Index(idx_path, name, max_op_n=self.max_op_n,
                         row_id_cap=self.max_row_id)
             idx.translate_factory = self.translate_factory

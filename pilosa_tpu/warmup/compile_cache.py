@@ -1,0 +1,125 @@
+"""Persistent XLA compile cache wiring (docs/warmup.md "Compile
+cache").
+
+jax ships an on-disk compilation cache (keyed by a hash of the lowered
+HLO + compile options + backend version); pointing it under data-dir
+means a restarted process REUSES yesterday's executables instead of
+re-lowering and re-compiling them.  The warmup replayer
+(warmup/replayer.py) drives the top-N corpus queries through the real
+compile paths at startup, so every hit lands here at disk speed instead
+of XLA-compile speed — that's the whole warm-start story: the corpus
+remembers WHAT to compile, this cache remembers the COMPILED BYTES.
+
+This module is deliberately thin glue:
+
+* ``configure(dir)`` flips the three jax config knobs (cache dir, and
+  both min-compile-time/min-entry-size floors to zero — the defaults
+  skip sub-second compiles, which on CPU smoke runs is everything).
+  Gated in try/except: an older jax without the knobs, or no jax at
+  all, degrades to no persistent cache, never a failed boot.
+* ``prune(dir, max_mb)`` LRU-prunes the cache directory to the
+  ``compile-cache-mb`` bound by file mtime (jax touches entries on
+  read), oldest first.  Runs at startup (before the cache is hot) and
+  on clean shutdown.
+
+The cache directory defaults to ``<data-dir>/.compile-cache`` (knob
+``compile-cache-dir``); ``off`` disables the whole subsystem.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Hidden: the holder scans data-dir subdirectories as indexes and
+# skips dot-dirs, so the cache must not look like an index.
+DEFAULT_SUBDIR = ".compile-cache"
+
+
+def resolve_dir(cache_dir: str, data_dir: str | None) -> str | None:
+    """The effective cache directory for the config knobs: explicit
+    path wins, "" means <data-dir>/.compile-cache, "off" (or "" with no
+    data dir) disables."""
+    if cache_dir == "off":
+        return None
+    if cache_dir:
+        return cache_dir
+    if data_dir:
+        return os.path.join(data_dir, DEFAULT_SUBDIR)
+    return None
+
+
+def configure(cache_dir: str) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir``;
+    returns False (disabled) when jax is missing or too old — a warm
+    start is an optimization, never a boot requirement."""
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # default floors skip fast/small compiles; the corpus replays
+        # exactly the programs we want cached, so cache everything
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return True
+    # lint: allow(swallowed-exception) — no jax / old jax / unwritable
+    # dir all mean "no persistent cache", a pure perf downgrade the
+    # warmup status surface reports as cacheEnabled=false
+    except Exception:
+        return False
+
+
+def cache_stats(cache_dir: str) -> dict:
+    """{files, bytes} for the status surfaces; never raises."""
+    files = total = 0
+    try:
+        for name in os.listdir(cache_dir):
+            p = os.path.join(cache_dir, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            if os.path.isfile(p):
+                files += 1
+                total += st.st_size
+    except OSError:
+        pass
+    return {"files": files, "bytes": total}
+
+
+def prune(cache_dir: str, max_mb: int) -> dict:
+    """Delete oldest-by-mtime cache files until the directory fits
+    ``max_mb`` (0 = unbounded).  Returns {files, bytes, removed,
+    removedBytes}; never raises — a prune failure costs disk, not
+    availability."""
+    entries = []
+    total = 0
+    try:
+        for name in os.listdir(cache_dir):
+            p = os.path.join(cache_dir, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            if os.path.isfile(p):
+                entries.append((st.st_mtime, st.st_size, p))
+                total += st.st_size
+    except OSError:
+        return {"files": 0, "bytes": 0, "removed": 0, "removedBytes": 0}
+    removed = removed_bytes = 0
+    if max_mb and max_mb > 0:
+        limit = max_mb * 1024 * 1024
+        entries.sort()  # oldest mtime first — LRU victims
+        i = 0
+        while total > limit and i < len(entries):
+            _, size, p = entries[i]
+            i += 1
+            try:
+                os.unlink(p)
+            except OSError:
+                continue
+            total -= size
+            removed += 1
+            removed_bytes += size
+    return {"files": len(entries) - removed, "bytes": total,
+            "removed": removed, "removedBytes": removed_bytes}

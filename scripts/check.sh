@@ -64,12 +64,17 @@ python -m pilosa_tpu.analysis
 # contract (a hostile flood's sheds land on the hostile tenant) — and
 # the degraded-result cache guard it pins prevents a partial answer
 # from being memoized as the real one.
+# The warm-start suite (docs/warmup.md) belongs with the durability
+# gates: the signature corpus takes kill -9 mid-append by design, so
+# its every-length truncation / every-byte corruption recovery — and
+# the guarantee that NO corpus state can fail READY — is a crash-safety
+# contract, not a perf test.
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' -p no:cacheprovider \
     tests/test_durability.py tests/test_crash.py tests/test_containers.py \
     tests/test_device_obs.py tests/test_ingest.py tests/test_wholequery.py \
     tests/test_routing.py tests/test_churn.py \
     tests/test_events.py tests/test_explain.py tests/test_cluster_obs.py \
-    tests/test_qwire.py tests/test_tenant.py
+    tests/test_qwire.py tests/test_tenant.py tests/test_warmup.py
 
 # committed bytecode/cache artifacts must never land in the tree (shell
 # stays the right layer for a git-index check)

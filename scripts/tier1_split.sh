@@ -62,6 +62,7 @@ tests/test_routing.py
 tests/test_server.py
 tests/test_tenant.py
 tests/test_topology.py
+tests/test_warmup.py
 "
 
 _check_partition() {
