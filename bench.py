@@ -88,7 +88,8 @@ def _device_telemetry() -> dict:
            "launches": led.launches_total,
            "rows": led.rows_actual_total,
            "padded": led.rows_padded_total,
-           "decode_bytes": led.decode_bytes_total}
+           "decode_bytes": led.decode_bytes_total,
+           "kernel_launches": led.kernel_launches_total}
     led.reset_decode_peak()
     return out
 
@@ -111,7 +112,18 @@ def _device_delta(before: dict) -> dict:
             "decode_mb": round(
                 (after["decode_bytes"] - before["decode_bytes"]) / 2**20,
                 2),
-            "decode_peak_mb": round(peak / 2**20, 2)}
+            "decode_peak_mb": round(peak / 2**20, 2),
+            "kernel_launches": after["kernel_launches"]
+            - before["kernel_launches"],
+            # resolved container-kernels backend this leg ran under
+            # (ops/kernels.py) — the per-leg BENCH_*.json provenance of
+            # whether decode went through the Pallas kernels or jnp
+            "kernel_backend": _kernel_backend()}
+
+
+def _kernel_backend() -> str:
+    from pilosa_tpu.ops import kernels
+    return kernels.resolve()
 
 
 def build_indexes():
@@ -563,6 +575,253 @@ def bench_config5_compressed(rng, n_shards=N_SHARDS5, budget_mb=768,
                 dense_set_mb / comp_mb, 1)
         return out
     finally:
+        _frag.COMPRESSED_RESIDENT = old_form
+        DEFAULT_BUDGET.limit_bytes = old_limit
+        ex.close()
+
+
+# -- SSB star-schema workload (docs/architecture.md "On native code and
+# Pallas"; the r10 on-TPU round's main leg) ---------------------------------
+
+N_SHARDS_SSB = 256  # ~268M fact rows at the 2^20-shard geometry
+
+# (field, rows): the denormalized dimension columns of an SSB lineorder
+# fact table, bitmap-encoded — each field partitions every fact column
+# into one selective row (d_year buckets, region/category codes) — plus
+# an 8-bucket revenue measure for the TopN/GroupBy legs.
+SSB_FIELDS = (("year", 7), ("region", 5), ("category", 12), ("rev", 8))
+
+
+def build_ssb(rng, n_shards=N_SHARDS_SSB, sparse=True):
+    """Wide denormalized star-schema fact index, SSB-shaped: one row of
+    ``ssb`` per fact, every dimension attribute denormalized onto it as
+    a selective Row (the reference's canonical star-join modeling —
+    dimension filters become Row intersects, no join machinery).  Every
+    column belongs to exactly one row per field, assigned in 32-column
+    blocks so the word-wise numpy oracle is exact.
+
+    ``sparse=True`` (default) keeps only ~1.5% of fact columns plus one
+    contiguous fully-populated region per shard — the scattered +
+    clustered mix the compressed container forms exist for, giving the
+    compressed-over-budget sub-leg array AND run containers to decode.
+    Returns (holder, ssb_words): ssb_words[shard] maps field ->
+    [rows, SHARD_WORDS] uint32 oracle block."""
+    from pilosa_tpu.core import SHARD_WORDS, VIEW_STANDARD
+    from pilosa_tpu.storage import Holder
+
+    h = Holder(None)
+    idx = h.create_index("ssb", track_existence=False)
+    views = {}
+    for name, _rows in SSB_FIELDS:
+        f = idx.create_field(name)
+        views[name] = f._create_view_if_not_exists(VIEW_STANDARD)
+    ssb_words: dict[int, dict[str, np.ndarray]] = {}
+    for shard in range(n_shards):
+        if sparse:
+            live = (rng.random(SHARD_WORDS) < 0.015).astype(np.uint32)
+            live *= np.uint32(0xFFFFFFFF)
+            start = int(rng.integers(0, SHARD_WORDS - 512))
+            live[start: start + 512] = 0xFFFFFFFF
+        else:
+            live = np.full(SHARD_WORDS, 0xFFFFFFFF, dtype=np.uint32)
+        per_field = {}
+        for name, n_rows in SSB_FIELDS:
+            assign = rng.integers(0, n_rows, size=SHARD_WORDS)
+            words = np.zeros((n_rows, SHARD_WORDS), dtype=np.uint32)
+            for r in range(n_rows):
+                words[r, assign == r] = 0xFFFFFFFF
+            words &= live[None, :]
+            fr = views[name].create_fragment_if_not_exists(shard)
+            for r in range(n_rows):
+                fr.set_row(r, words[r])
+            per_field[name] = words
+        ssb_words[shard] = per_field
+    return h, ssb_words
+
+
+def _ssb_batch(rng, B):
+    """B calls cycling the three SSB query shapes: Q1-style restricted
+    Count (Intersect of two dimension rows), Q2-style TopN of the
+    revenue measure under a dimension filter, Q3-style two-dimension
+    GroupBy under a region filter."""
+    out = []
+    for kind in rng.integers(0, 3, size=B):
+        y = rng.integers(0, 7)
+        rg = rng.integers(0, 5)
+        c = rng.integers(0, 12)
+        if kind == 0:
+            out.append(f"Count(Intersect(Row(year={y}), "
+                       f"Row(region={rg})))")
+        elif kind == 1:
+            out.append(f"TopN(rev, Intersect(Row(region={rg}), "
+                       f"Row(category={c})), n=5)")
+        else:
+            out.append(f"GroupBy(Rows(year), Rows(region), "
+                       f"Row(category={c}))")
+    return " ".join(out)
+
+
+def _ssb_norm(results):
+    """Mixed SSB results (Count ints, TopN Pairs, GroupBy GroupCounts)
+    -> comparable plain values; _smoke_norm is TopN-only."""
+    return [[p.to_dict() for p in r] if isinstance(r, list) else r
+            for r in results]
+
+
+def oracle_ssb_topn(ssb_words, shards, rg, c, n=5):
+    """Exact word-wise answer for the Q2-style TopN (the SSB
+    answer-equality gate, like oracle_topn5 for config 5)."""
+    counts = np.zeros(8, dtype=np.int64)
+    for s in shards:
+        w = ssb_words[s]
+        mask = w["region"][rg] & w["category"][c]
+        for m in range(8):
+            counts[m] += int(np.bitwise_count(w["rev"][m] & mask).sum())
+    order = sorted(range(8), key=lambda m: (-counts[m], m))
+    return [(m, int(counts[m])) for m in order[:n] if counts[m] > 0]
+
+
+def bench_ssb(rng, n_shards=N_SHARDS_SSB, budget_mb=96, B=24, nb=8,
+              reps=1):
+    """SSB star-schema main leg: the sparse fact corpus queried with the
+    three SSB shapes, as two sub-legs on identical data/queries —
+    ``resident`` (dense form, unlimited budget: the anchor) vs
+    ``compressed`` (packed container streams under a budget below the
+    dense working set, decoding per launch through whatever
+    container-kernels backend the process resolved — recorded per leg in
+    ``device.kernel_backend``).  Runnable unchanged on real TPU, where
+    the compressed sub-leg exercises the fused Pallas kernels."""
+    from pilosa_tpu.executor import Executor as _Ex
+    from pilosa_tpu.storage import fragment as _frag
+    from pilosa_tpu.storage.membudget import DEFAULT_BUDGET
+
+    h, ssb_words = build_ssb(rng, n_shards=n_shards, sparse=True)
+    ex = _Ex(h, use_mesh=True)
+    old_limit = DEFAULT_BUDGET.limit_bytes
+    old_form = _frag.COMPRESSED_RESIDENT
+    n_rows_total = sum(r for _, r in SSB_FIELDS)
+    dense_set_mb = (n_shards * n_rows_total * 32768 * 4) >> 20
+    out = {"columns": n_shards << 20, "budget_mb": budget_mb,
+           "dense_working_set_mb": dense_set_mb,
+           "fields": dict(SSB_FIELDS)}
+    subsets = [list(map(int, s))
+               for s in np.array_split(np.arange(n_shards), 4)]
+
+    def leg(compressed, limit_mb):
+        _frag.COMPRESSED_RESIDENT = compressed
+        DEFAULT_BUDGET.limit_bytes = 1
+        DEFAULT_BUDGET.shrink_to_limit()
+        DEFAULT_BUDGET.limit_bytes = \
+            None if limit_mb is None else limit_mb << 20
+        DEFAULT_BUDGET.reset_peak()
+        for sub in subsets:  # warm: compile + stage
+            ex.execute("ssb", _ssb_batch(rng, B), shards=sub)
+        dev0 = _device_telemetry()
+
+        def run():
+            batches = [_ssb_batch(rng, B) for _ in range(nb)]
+            order = [subsets[i % 4] for i in range(nb)]
+            return _run_batches(ex, "ssb", batches, 1, shards_of=order)
+
+        (qps, _bat_s, p50_s), spread = best_of(run, n=reps)
+        stats = DEFAULT_BUDGET.stats()
+        return {
+            "qps": round(qps, 1),
+            "batch_p50_ms": round(p50_s * 1e3, 1),
+            "spread": spread,
+            "resident_mb": stats["residentBytes"] >> 20,
+            "compressed_mb": round(stats["compressedBytes"] / 2**20, 1),
+            "budget_held": limit_mb is None or
+            stats["peakBytes"] <= (limit_mb << 20),
+            "device": _device_delta(dev0),
+        }
+
+    try:
+        # answer-equality in both forms before any timing
+        q = "TopN(rev, Intersect(Row(region=1), Row(category=3)), n=5)"
+        want = oracle_ssb_topn(ssb_words, range(n_shards), 1, 3)
+        for form in (False, True):
+            _frag.COMPRESSED_RESIDENT = form
+            DEFAULT_BUDGET.limit_bytes = budget_mb << 20
+            DEFAULT_BUDGET.shrink_to_limit()
+            got = ex.execute("ssb", q)
+            assert [(p.id, p.count) for p in got[0]] == want, \
+                f"ssb compressed={form} answer diverged from the oracle"
+
+        out["resident"] = leg(False, None)
+        out["compressed"] = leg(True, budget_mb)
+        anchor = out["resident"]["qps"]
+        if anchor > 0:
+            out["compressed"]["cliff_vs_resident"] = round(
+                anchor / max(out["compressed"]["qps"], 1e-9), 1)
+        comp_mb = out["compressed"]["compressed_mb"]
+        if comp_mb > 0:
+            out["effective_capacity_ratio"] = round(
+                dense_set_mb / comp_mb, 1)
+        return out
+    finally:
+        _frag.COMPRESSED_RESIDENT = old_form
+        DEFAULT_BUDGET.limit_bytes = old_limit
+        ex.close()
+
+
+def run_ssb_smoke(rng) -> dict:
+    """SSB leg of --smoke: the star-schema corpus at 8 shards run
+    dense-resident (reference), compressed-jnp, and compressed-PALLAS
+    (interpreted on CPU — the same kernels a TPU compiles), asserting
+    all three byte-identical, at least one container-kernel launch in
+    the pallas leg's ledger bracket, and none in the jnp kill-switch
+    leg."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.ops import kernels
+    from pilosa_tpu.storage import fragment as _frag
+    from pilosa_tpu.storage.membudget import DEFAULT_BUDGET
+
+    n_shards = 8
+    h, ssb_words = build_ssb(rng, n_shards=n_shards, sparse=True)
+    ex = Executor(h, use_mesh=True)
+    old_limit = DEFAULT_BUDGET.limit_bytes
+    old_form = _frag.COMPRESSED_RESIDENT
+    old_backend = kernels.CONTAINER_KERNELS
+    batches = [_ssb_batch(rng, 6) for _ in range(3)]
+    full_q = "TopN(rev, Intersect(Row(region=1), Row(category=3)), n=5)"
+    out = {}
+    try:
+        _frag.COMPRESSED_RESIDENT = False
+        DEFAULT_BUDGET.limit_bytes = None
+        want = [_ssb_norm(ex.execute("ssb", b)) for b in batches]
+        assert _smoke_norm(ex.execute("ssb", full_q))[0] == \
+            oracle_ssb_topn(ssb_words, range(n_shards), 1, 3), \
+            "ssb dense answer diverged from the oracle"
+
+        _frag.COMPRESSED_RESIDENT = True
+        DEFAULT_BUDGET.limit_bytes = 16 << 20
+        for backend in ("jnp", "pallas"):
+            kernels.CONTAINER_KERNELS = backend
+            DEFAULT_BUDGET.shrink_to_limit()
+            dev0 = _device_telemetry()
+            t0 = time.perf_counter()
+            got = [_ssb_norm(ex.execute("ssb", b)) for b in batches]
+            leg_s = time.perf_counter() - t0
+            dev = _device_delta(dev0)
+            assert got == want, \
+                f"ssb compressed-{backend} results diverged from the " \
+                f"dense run"
+            assert dev["kernel_backend"] == backend
+            if backend == "pallas":
+                assert dev["kernel_launches"] > 0, \
+                    "pallas leg never launched a container kernel"
+            else:
+                assert dev["kernel_launches"] == 0, \
+                    "jnp kill-switch leg launched container kernels"
+            out[backend] = {"leg_s": round(leg_s, 2), "device": dev}
+        st = DEFAULT_BUDGET.stats()
+        assert st["compressedBytes"] > 0, \
+            "ssb smoke never staged a packed stream"
+        out["compressed_mb"] = round(st["compressedBytes"] / 2**20, 2)
+        return out
+    finally:
+        kernels.CONTAINER_KERNELS = old_backend
         _frag.COMPRESSED_RESIDENT = old_form
         DEFAULT_BUDGET.limit_bytes = old_limit
         ex.close()
@@ -2749,6 +3008,7 @@ def run_smoke():
     out["wire"] = run_wire_smoke(np.random.default_rng(SEED + 12))
     out["tenant"] = run_tenant_smoke(np.random.default_rng(SEED + 13))
     out["compressed"] = run_compressed_smoke(np.random.default_rng(SEED + 6))
+    out["ssb"] = run_ssb_smoke(np.random.default_rng(SEED + 15))
     out["ingest"] = run_ingest_smoke(np.random.default_rng(SEED + 8))
     out["cache"] = run_cache_smoke(np.random.default_rng(SEED + 3))
     out["overload"] = run_overload_smoke()
@@ -2819,6 +3079,16 @@ def main():
         print(f"config 5 compressed leg failed: {e!r}", file=sys.stderr)
         traceback.print_exc()
         cfg5c = None
+
+    # SSB star-schema config (the r10 on-TPU round's main leg):
+    # resident vs compressed-over-budget, per-leg kernel backend
+    try:
+        ssb_leg = bench_ssb(np.random.default_rng(SEED + 15))
+    except Exception as e:
+        import traceback
+        print(f"ssb config failed: {e!r}", file=sys.stderr)
+        traceback.print_exc()
+        ssb_leg = None
 
     try:
         cfg5d = bench_config5_distributed(rng)
@@ -2977,6 +3247,8 @@ def main():
         configs["12_internal_wire"] = wire_leg
     if tenant_leg:
         configs["13_tenant_isolation"] = tenant_leg
+    if ssb_leg:
+        configs["14_ssb_star_schema"] = ssb_leg
 
     print(json.dumps({
         "metric": "engine_intersect8_count_qps_1M_cols",

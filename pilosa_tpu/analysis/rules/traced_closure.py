@@ -27,6 +27,9 @@ from ..astlint import rule
 TRACE_WRAPPERS = {
     "jit", "vmap", "pmap", "shard_map", "_shard_map", "_jit_shard_map",
     "_InstrumentedExec", "eval_shape", "make_jaxpr",
+    # Pallas kernel bodies (ops/kernels.py) are traced exactly like jit
+    # bodies — a pallas_call re-trace re-reads closure cells the same way
+    "pallas_call",
 }
 
 

@@ -512,6 +512,10 @@ max-op-n = 10000
 # compress-max-density = 0.5   # dense fallback: compress only below
 #                              # this fraction of the dense footprint
 # decode-workspace-mb = 1024   # per-launch dense decode ceiling
+#                              # (bounds the jnp backend only)
+# container-kernels = "auto"   # container decode backend: auto = fused
+#                              # Pallas kernels on TPU, jnp elsewhere;
+#                              # "jnp" is the kill switch
 # cross-query dynamic batching (docs/batching.md)
 # dispatch-batch = true         # fuse compatible in-flight queries
 # dispatch-batch-max = 32       # queries per fused device launch
@@ -622,6 +626,7 @@ def cmd_config(args) -> int:
     print(f"compressed-resident = {str(cfg.compressed_resident).lower()}")
     print(f"compress-max-density = {cfg.compress_max_density}")
     print(f"decode-workspace-mb = {cfg.decode_workspace_mb}")
+    print(f"container-kernels = {q(cfg.container_kernels)}")
     print(f"ingest-flush-ms = {cfg.ingest_flush_ms}")
     print(f"ingest-delta-mb = {cfg.ingest_delta_mb}")
     print(f"ingest-max-frame-mb = {cfg.ingest_max_frame_mb}")

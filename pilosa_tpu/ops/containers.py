@@ -37,9 +37,12 @@ duration of one launch (the reusable dense workspace,
 docs/memory-budget.md), never as persistent HBM residents.
 
 Everything here runs through XLA (gather/scatter/mask ops the TPU VPU
-executes at full lane width); a hand-scheduled Pallas variant that
-decodes containers HBM->VMEM tile-by-tile is the remaining headroom and
-slots in behind the same ``decode_block`` signature.
+executes at full lane width).  The hand-scheduled Pallas variant that
+decodes containers HBM->VMEM tile-by-tile lives in ops/kernels.py behind
+the same ``decode_block`` signature, selected by the
+``container-kernels`` knob (``kernels.resolve()``); this module is the
+``jnp`` backend — the kill switch — and the host-side pack/oracle layer
+both backends share.
 """
 
 from __future__ import annotations
@@ -313,7 +316,8 @@ def pad_packed(p: Packed) -> tuple[np.ndarray, ...]:
 
 
 @functools.lru_cache(maxsize=None)
-def _decode_jit(rows: int, words: int, a_bucket: int, r_bucket: int):
+def _decode_jit(rows: int, words: int, a_bucket: int, r_bucket: int,
+                backend: str = "jnp"):
     import jax
 
     def _traced(*a, **k):
@@ -321,6 +325,9 @@ def _decode_jit(rows: int, words: int, a_bucket: int, r_bucket: int):
         # per-bucket compile detector (docs/observability.md)
         from ..utils import devobs
         devobs.COMPILES.mark_traced()
+        if backend == "pallas":
+            from . import kernels
+            return kernels.decode_block(*a, **k)
         return decode_block(*a, **k)
 
     return jax.jit(functools.partial(
@@ -342,9 +349,12 @@ def upload_decode(p: Packed, rows: int, target=None,
 
     from ..utils import devobs
 
+    from . import kernels
+
     arrs = [jax.device_put(a, target) for a in pad_packed(p)]
     a_b, r_b = pow2_bucket(p.a_max), pow2_bucket(p.r_max)
-    fn = _decode_jit(rows, words, a_b, r_b)
+    backend = kernels.resolve()
+    fn = _decode_jit(rows, words, a_b, r_b, backend)
     reg = devobs.COMPILES
     reg.begin_call()
     t0 = _time.perf_counter()
@@ -353,11 +363,23 @@ def upload_decode(p: Packed, rows: int, target=None,
         # the container/payload pow2 buckets are intended shape
         # polymorphism (one jit, one specialization per bucket), so they
         # belong IN the signature — without them a second bucket of the
-        # same jit would read as a false retrace alarm
+        # same jit would read as a false retrace alarm.  The backend tag
+        # splits the pallas and jnp executables the same way (a knob
+        # flip is a new signature, not a retrace).
         c_b = pow2_bucket(p.keys.size)
         p_b = pow2_bucket(p.payload.size)
         reg.note_call(
-            f"decode:{rows}x{words}:c{c_b}:p{p_b}:a{a_b}:r{r_b}",
+            f"decode:{rows}x{words}:c{c_b}:p{p_b}:a{a_b}:r{r_b}"
+            f":{backend}",
             "decode", _time.perf_counter() - t0,
             devobs.fingerprint(arrs))
+    if backend == "pallas":
+        tiles = rows * max(words // CONTAINER_WORDS, 1)
+        devobs.LEDGER.record(
+            sig=f"decode:{rows}x{words}:c{pow2_bucket(p.keys.size)}"
+                f":p{pow2_bucket(p.payload.size)}:a{a_b}:r{r_b}:pallas",
+            kind="decode", shards=1, shards_padded=1, batch_rows=rows,
+            batch_rows_padded=rows, queue_s=0.0,
+            dispatch_s=_time.perf_counter() - t0, decode_bytes=0,
+            compiled=reg.traced(), kernel_launches=1, kernel_tiles=tiles)
     return out

@@ -1,0 +1,334 @@
+"""Pallas container kernels: HBM->VMEM decode + fused bitwise-op/popcount
+in one launch (docs/architecture.md "On native code and Pallas").
+
+The compressed-residency layer (ops/containers.py) decodes packed
+array/bitmap/run container streams to dense tiles with pure-jnp
+gather/scatter — XLA schedules that decode through HBM-resident
+temporaries bounded only by ``decode-workspace-mb``.  This module is the
+hand-scheduled alternative: Pallas kernels that walk the same PR 7
+key/type/count/offset/payload tables CONTAINER-TILE-BY-TILE, so each
+2048-word dense tile is materialised in a VMEM block, consumed, and
+overwritten by the next grid step instead of round-tripping through HBM.
+Two kernels ship:
+
+* ``decode_block`` — drop-in for ``containers.decode_block`` (same
+  signature, same answer): grid over the fragment's ``rows x 16`` output
+  container tiles, each step decoding one container form (bitmap:
+  dynamic-slice copy; array: one-hot scatter of (slot, value) entries;
+  run: per-word range masks OR-reduced) into its (16, 128) VMEM block.
+* ``fused_row_counts`` — the headline fusion: decode + optional AND with
+  a dense filter segment + per-row popcount accumulation in ONE kernel,
+  so the decoded words never exist outside the tile at all (the
+  TopN/Rows ``row_counts`` hot path, parallel/mesh_exec.py).
+
+Backend selection rides the ``container-kernels`` knob
+(``CONTAINER_KERNELS``, set process-wide from the server config like
+``DECODE_WORKSPACE_BYTES``): ``auto`` resolves to the Pallas kernels on
+TPU and the jnp decode elsewhere, ``pallas`` forces the kernels
+(executing through the Pallas INTERPRETER off-TPU, so the whole path is
+differentially testable in CPU tier-1), and ``jnp`` is the kill switch
+restoring the PR 7 path exactly.  The resolved backend is part of every
+compressed ``Fragment.device_sig()`` (the kernel-backend axis), so a
+flip changes the group signatures, rebuilds stacks, and recompiles
+executables instead of silently replaying a jnp-compiled program.
+
+TPU-lowering caveat: the kernel bodies use word-granularity dynamic
+slices and gathers that the Pallas interpreter (and a TPU with relaxed
+layout constraints) accepts but that may need 128-lane alignment work
+before they lower on every real-TPU toolchain; the interpret-mode
+differential pins the SEMANTICS now so the r10 on-TPU round only has to
+tune the schedule.  Buckets whose per-tile working set (whole payload +
+form intermediates) exceeds ``VMEM_TILE_BUDGET_BYTES`` fall back to the
+jnp decode — the VMEM budget rule — statically per signature, so the
+choice is trace-stable.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..core import CONTAINER_WORDS, SHARD_WORDS, WORD_BITS
+from .containers import TYPE_ARRAY, TYPE_BITMAP, TYPE_RUN
+
+# Container-decode kernel backend: "auto" | "pallas" | "jnp".
+# Process-wide, set from the server config (container-kernels) like
+# fragment.COMPRESSED_RESIDENT; bench legs and tests flip it directly.
+CONTAINER_KERNELS = "auto"
+
+# One container's 2048 words as a VMEM tile: 16 sublanes x 128 lanes.
+TILE_ROWS = CONTAINER_WORDS // 128    # 16
+TILE_LANES = 128
+TILES_PER_SHARD_ROW = SHARD_WORDS // CONTAINER_WORDS  # 16
+
+# The VMEM budget rule: a decode bucket only takes the Pallas path when
+# its per-tile working set — the whole (pow2-bucketed) payload the
+# kernel keeps VMEM-resident plus the array/run form intermediates and
+# the tile itself — fits under this.  Over-budget buckets fall back to
+# the jnp decode; the decision depends only on signature fields, so it
+# is identical on every trace of one executable.
+VMEM_TILE_BUDGET_BYTES = 12 << 20
+
+
+@functools.lru_cache(maxsize=1)
+def _platform() -> str:
+    """Device platform this process compiles for (fixed per process —
+    jax picks the backend once)."""
+    import jax
+    return jax.default_backend()
+
+
+@functools.lru_cache(maxsize=1)
+def _pallas_available() -> bool:
+    """Whether the installed jax ships jax.experimental.pallas — gated
+    so a trimmed install degrades to the jnp backend instead of an
+    ImportError on the query path."""
+    try:
+        from jax.experimental import pallas  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
+def resolve(mode: str | None = None) -> str:
+    """Resolved backend ("pallas" | "jnp") for the given knob value
+    (default: the process-wide ``CONTAINER_KERNELS``)."""
+    m = CONTAINER_KERNELS if mode is None else mode
+    if m == "jnp":
+        return "jnp"
+    if m == "pallas":
+        return "pallas" if _pallas_available() else "jnp"
+    # auto: kernels where they pay (TPU), jnp elsewhere — CPU tier-1
+    # exercises the kernels only when a test/bench forces "pallas"
+    return "pallas" if (_platform() == "tpu" and _pallas_available()) \
+        else "jnp"
+
+
+def interpret_mode() -> bool:
+    """Off-TPU the kernels run through the Pallas interpreter — same
+    kernel logic, XLA:CPU execution — so tier-1 can differentially test
+    the exact code path the TPU compiles."""
+    return _platform() != "tpu"
+
+
+def sig_tag() -> str:
+    """The kernel-backend axis of compressed ``Fragment.device_sig()``
+    tuples (storage/fragment.py): the RESOLVED backend, so an
+    auto->pallas TPU process and an auto->jnp CPU process produce
+    distinct signatures and a knob flip rebuilds stacks/executables."""
+    return resolve()
+
+
+def sig_backend(sig) -> str:
+    """Backend recorded in a compressed group signature ('z', rows, C,
+    P, A, R, backend); signatures minted before the backend axis read as
+    jnp (the decode they compiled)."""
+    return sig[6] if len(sig) > 6 else "jnp"
+
+
+def fits_vmem(payload_bucket: int, a_bucket: int, r_bucket: int) -> bool:
+    """The VMEM budget rule (module docstring): whether a decode
+    bucket's per-tile working set fits ``VMEM_TILE_BUDGET_BYTES``."""
+    est = (max(payload_bucket, CONTAINER_WORDS)
+           + a_bucket * CONTAINER_WORDS      # one-hot scatter compare
+           + r_bucket * CONTAINER_WORDS      # per-run range masks
+           + CONTAINER_WORDS) * 4
+    return est <= VMEM_TILE_BUDGET_BYTES
+
+
+def _tile_slots(keys, tiles: int):
+    """int32[tiles] inverse container map: output tile t's index into
+    the container tables, -1 where no container covers the tile.  Keys
+    are unique and padding rows carry key -1, so one drop-mode scatter
+    (outside the kernel) builds it."""
+    import jax.numpy as jnp
+    C = keys.shape[0]
+    idx = jnp.where(keys >= 0, keys, tiles).astype(jnp.int32)
+    return jnp.full((tiles,), -1, dtype=jnp.int32).at[idx].set(
+        jnp.arange(C, dtype=jnp.int32), mode="drop")
+
+
+def _pad_payload(payload):
+    """Payload padded to at least one container tile so the kernel's
+    static-size bitmap dynamic-slice never exceeds the buffer."""
+    import jax.numpy as jnp
+    P = payload.shape[0]
+    if P >= CONTAINER_WORDS:
+        return payload
+    return jnp.zeros(CONTAINER_WORDS, dtype=jnp.uint32).at[:P].set(payload)
+
+
+def _container_tile(pv, typ, cnt, off, a_bucket: int, r_bucket: int):
+    """One container's dense (TILE_ROWS, TILE_LANES) word tile, decoded
+    from the VMEM-resident payload ``pv`` — the per-grid-step body both
+    kernels share.  Mirrors containers.decode_block's per-container
+    math exactly (bitmap copy / array one-hot scatter / run range
+    masks); a_bucket/r_bucket of 0 compile that form out."""
+    import jax
+    import jax.numpy as jnp
+    cw = CONTAINER_WORDS
+    # bitmap: contiguous copy.  dynamic_slice clamps the start, so a
+    # non-bitmap off near the buffer end reads garbage that the where()
+    # discards — never out of bounds.
+    bm = jax.lax.dynamic_slice(pv, (off,), (cw,))
+    tile = jnp.where(typ == TYPE_BITMAP, bm, jnp.uint32(0))
+    j = jnp.arange(cw, dtype=jnp.int32)
+    if a_bucket:
+        e = jnp.arange(a_bucket, dtype=jnp.int32)
+        live = (e < cnt) & (typ == TYPE_ARRAY)
+        slots = jnp.where(live, pv.at[off + e].get(
+            mode="fill", fill_value=0).astype(jnp.int32), -1)
+        vals = pv.at[off + cnt + e].get(mode="fill", fill_value=0)
+        hit = slots[:, None] == j[None, :]               # [a_bucket, cw]
+        tile = tile | jax.lax.reduce(
+            jnp.where(hit, vals[:, None], jnp.uint32(0)), np.uint32(0),
+            jax.lax.bitwise_or, dimensions=(0,))
+    if r_bucket:
+        r = jnp.arange(r_bucket, dtype=jnp.int32)
+        live = (r < cnt) & (typ == TYPE_RUN)
+        rs = jnp.where(live, pv.at[off + 2 * r].get(
+            mode="fill", fill_value=0).astype(jnp.int32), 0)
+        re_ = jnp.where(live, pv.at[off + 2 * r + 1].get(
+            mode="fill", fill_value=0).astype(jnp.int32), 0)
+        base = j * WORD_BITS
+        lo = jnp.clip(rs[:, None] - base[None, :], 0, WORD_BITS)
+        hi = jnp.clip(re_[:, None] - base[None, :], 0, WORD_BITS)
+        full = jnp.uint32(0xFFFFFFFF)
+        mhi = jnp.where(hi == 0, jnp.uint32(0),
+                        full >> (WORD_BITS - hi).astype(jnp.uint32))
+        mlo = jnp.where(lo == 0, jnp.uint32(0),
+                        full >> (WORD_BITS - lo).astype(jnp.uint32))
+        tile = tile | jax.lax.reduce(mhi & ~mlo, np.uint32(0),
+                                     jax.lax.bitwise_or, dimensions=(0,))
+    return tile.reshape(TILE_ROWS, TILE_LANES)
+
+
+def decode_block(keys, types, counts, offsets, payload, *, rows: int,
+                 words: int = SHARD_WORDS, a_bucket: int = 0,
+                 r_bucket: int = 0):
+    """Pallas drop-in for ``containers.decode_block``: decode one
+    fragment's packed stream to dense ``uint32[rows, words]``, one
+    container tile per grid step.  Same arguments, same answer; buckets
+    over the VMEM budget rule (and degenerate shapes) fall back to the
+    jnp decode."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import containers
+
+    C = keys.shape[0]
+    if (C == 0 or rows == 0 or words % CONTAINER_WORDS
+            or not fits_vmem(payload.shape[0], a_bucket, r_bucket)):
+        return containers.decode_block(
+            keys, types, counts, offsets, payload, rows=rows, words=words,
+            a_bucket=a_bucket, r_bucket=r_bucket)
+    from jax.experimental import pallas as pl
+
+    tiles = rows * (words // CONTAINER_WORDS)
+    slot = _tile_slots(keys, tiles)
+    pay = _pad_payload(payload)
+
+    def kernel(slot_ref, types_ref, counts_ref, offsets_ref, pay_ref,
+               out_ref):
+        t = pl.program_id(0)
+        c = slot_ref[...][t]
+        live = c >= 0
+        ci = jnp.where(live, c, 0)
+        typ = jnp.where(live, types_ref[...][ci], -1)
+        cnt = jnp.where(live, counts_ref[...][ci], 0)
+        off = jnp.where(live, offsets_ref[...][ci], 0)
+        out_ref[...] = _container_tile(pay_ref[...], typ, cnt, off,
+                                       a_bucket, r_bucket)
+
+    full = [slot, types, counts, offsets, pay]
+    out = pl.pallas_call(
+        kernel,
+        grid=(tiles,),
+        in_specs=[pl.BlockSpec(a.shape, _full_block) for a in full],
+        out_specs=pl.BlockSpec((TILE_ROWS, TILE_LANES), lambda t: (t, 0)),
+        out_shape=jax.ShapeDtypeStruct((tiles * TILE_ROWS, TILE_LANES),
+                                       jnp.uint32),
+        interpret=interpret_mode(),
+    )(*full)
+    return out.reshape(rows, words)
+
+
+def _full_block(t):
+    # whole-array input block every grid step (tables + payload stay
+    # VMEM-resident across the container tiles of one fragment)
+    return (0,)
+
+
+def fused_row_counts(keys, types, counts, offsets, payload, filt=None, *,
+                     rows: int, words: int = SHARD_WORDS,
+                     a_bucket: int = 0, r_bucket: int = 0):
+    """Decode + optional AND-with-filter + per-row popcount in ONE
+    kernel launch: int32[rows] set-bit counts of a packed fragment,
+    optionally masked by a dense ``uint32[words]`` segment.  The decoded
+    words exist only as the grid step's VMEM tile — no dense
+    ``[rows, words]`` temporary at all (the jnp path's decode output).
+    Falls back to decode+popcount via jnp under the same conditions as
+    ``decode_block``."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import containers
+
+    C = keys.shape[0]
+    if (C == 0 or rows == 0 or words % CONTAINER_WORDS
+            or not fits_vmem(payload.shape[0], a_bucket, r_bucket)):
+        frag = containers.decode_block(
+            keys, types, counts, offsets, payload, rows=rows, words=words,
+            a_bucket=a_bucket, r_bucket=r_bucket)
+        if filt is not None:
+            frag = frag & filt[None, :]
+        return jnp.sum(jax.lax.population_count(frag).astype(jnp.int32),
+                       axis=-1)
+    from jax.experimental import pallas as pl
+
+    tpr = words // CONTAINER_WORDS
+    tiles = rows * tpr
+    slot = _tile_slots(keys, tiles)
+    pay = _pad_payload(payload)
+
+    def kernel(slot_ref, types_ref, counts_ref, offsets_ref, pay_ref,
+               *rest):
+        filt_out = rest
+        t = pl.program_id(0)
+        c = slot_ref[...][t]
+        live = c >= 0
+        ci = jnp.where(live, c, 0)
+        typ = jnp.where(live, types_ref[...][ci], -1)
+        cnt = jnp.where(live, counts_ref[...][ci], 0)
+        off = jnp.where(live, offsets_ref[...][ci], 0)
+        tile = _container_tile(pay_ref[...], typ, cnt, off,
+                               a_bucket, r_bucket)
+        if len(filt_out) == 2:
+            tile = tile & filt_out[0][...]
+        out_ref = filt_out[-1]
+        # out block (1, 1) revisited by the row's tpr consecutive steps:
+        # zero on the first, accumulate the tile popcount on each
+        @pl.when(t % tpr == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+        out_ref[...] += jnp.sum(
+            jax.lax.population_count(tile).astype(jnp.int32))[None, None]
+
+    full = [slot, types, counts, offsets, pay]
+    in_specs = [pl.BlockSpec(a.shape, _full_block) for a in full]
+    if filt is not None:
+        # the filter segment's matching container tile rides in a
+        # (16, 128) block indexed by the step's position within the row
+        full.append(filt.reshape(tpr * TILE_ROWS, TILE_LANES))
+        in_specs.append(pl.BlockSpec((TILE_ROWS, TILE_LANES),
+                                     lambda t, _tpr=tpr: (t % _tpr, 0)))
+    out = pl.pallas_call(
+        kernel,
+        grid=(tiles,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1), lambda t, _tpr=tpr: (t // _tpr, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, 1), jnp.int32),
+        interpret=interpret_mode(),
+    )(*full)
+    return out[:, 0]

@@ -69,7 +69,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..core import SHARD_WORDS
+from ..core import CONTAINER_WORDS, SHARD_WORDS
 from ..ops import bsi
 from ..executor.plan import eval_plan, parametrize, plan_inputs
 from ..utils import devobs as _devobs
@@ -126,19 +126,46 @@ def _unpack_frags(layout, arrays):
     """Inside a per-shard (vmapped) body: decode compressed inputs to
     dense [rows, W] tiles — the decode-at-op-time step, fused into the
     op's own executable so dense tiles exist only as launch-local XLA
-    temporaries — and map every key to its dense fragment."""
-    from ..ops import containers
+    temporaries — and map every key to its dense fragment.  Each entry's
+    signature carries the container-kernels backend it was planned under
+    (storage/fragment.py device_sig), so the dispatch here is static per
+    layout: 'pallas' entries decode through the ops/kernels.py Pallas
+    kernel (tile-by-tile in VMEM), the rest through the jnp gather path."""
+    from ..ops import containers, kernels
     out = {}
     i = 0
     for k, n, s in layout:
         if n == 1:
             out[k] = arrays[i]
         else:
-            out[k] = containers.decode_block(
+            dec = kernels.decode_block \
+                if kernels.sig_backend(s) == "pallas" \
+                else containers.decode_block
+            out[k] = dec(
                 *arrays[i: i + n], rows=s[1], words=SHARD_WORDS,
                 a_bucket=s[4], r_bucket=s[5])
         i += n
     return out
+
+
+def _fused_entry(layout, key):
+    """(flat-arg index, sig) of ``key``'s layout entry when it is a
+    compressed entry planned for the Pallas backend — the condition
+    under which a per-shard body may route the whole decode+op+popcount
+    chain through one fused kernel (kernels.fused_row_counts) instead of
+    decode-then-op.  None otherwise (dense entry, jnp backend, or the
+    bucket failed the VMEM rule).  Static per layout, so the per-shard
+    body's branch is resolved at trace time."""
+    from ..ops import kernels
+    i = 0
+    for k, n, s in layout:
+        if k == key:
+            if (n > 1 and kernels.sig_backend(s) == "pallas"
+                    and kernels.fits_vmem(s[3], s[4], s[5])):
+                return i, s
+            return None
+        i += n
+    return None
 
 # Multi-device collective programs must be ENQUEUED in one consistent
 # order across all device queues: two threads (concurrent server
@@ -181,9 +208,11 @@ class _InstrumentedExec:
     _ShardSchedule."""
 
     __slots__ = ("fn", "sig", "kind", "detail", "n_fixed",
-                 "decode_per_shard")
+                 "decode_per_shard", "kernels_per_shard",
+                 "kernel_tiles_per_shard")
 
     def __init__(self, fn, key, layout):
+        from ..ops import kernels as _kernels
         self.fn = fn
         self.kind = key[0] if key and isinstance(key[0], str) else "exec"
         self.sig = _devobs.sig_of(key)
@@ -191,9 +220,18 @@ class _InstrumentedExec:
         # leading replicated (P()) args before the stacked fragment args
         self.n_fixed = 2 if self.kind == "group_countsB" else 1
         # transient dense tiles this executable decodes per stacked
-        # shard row (compressed layout entries expand inside the launch)
+        # shard row (compressed layout entries expand inside the launch).
+        # Pallas-backend entries don't materialise that workspace — they
+        # stream VMEM container tiles — so they count as embedded kernel
+        # launches + tiles instead of decode bytes.
         self.decode_per_shard = sum(
-            s[1] * SHARD_WORDS * 4 for _, n, s in layout if n > 1)
+            s[1] * SHARD_WORDS * 4 for _, n, s in layout
+            if n > 1 and _kernels.sig_backend(s) != "pallas")
+        pallas = [s for _, n, s in layout
+                  if n > 1 and _kernels.sig_backend(s) == "pallas"]
+        self.kernels_per_shard = len(pallas)
+        self.kernel_tiles_per_shard = sum(
+            s[1] * (SHARD_WORDS // CONTAINER_WORDS) for s in pallas)
 
     def __call__(self, *args, _launch_meta=None):
         reg = _devobs.COMPILES
@@ -230,7 +268,9 @@ class _InstrumentedExec:
             tickets=ctx.get("tickets", 1),
             dispatch_s=dt, compiled=compiled,
             decode_bytes=self.decode_per_shard * shards,
-            slice_pos=_devobs.current_slice())
+            slice_pos=_devobs.current_slice(),
+            kernel_launches=self.kernels_per_shard * shards,
+            kernel_tiles=self.kernel_tiles_per_shard * shards)
         prof = qprof.current()
         if prof is not None:
             # rows/padding/decode tags feed the EXPLAIN launches section
@@ -326,6 +366,16 @@ class MeshExecutor:
         executable wrapped in its telemetry hooks (_InstrumentedExec)."""
         fn = self._cache.get(key)
         if fn is None:
+            from ..ops import kernels as _kernels
+            if any(n > 1 and _kernels.sig_backend(s) == "pallas"
+                   for _, n, s in layout):
+                # shard_map's replication checker has no rule for
+                # pallas_call (jax suggests check_rep=False as the
+                # workaround); these bodies' outputs follow the same
+                # psum/P(SHARD_AXIS) patterns the checker validates on
+                # the jnp variants of the identical layouts
+                check_vma = False
+
             def traced_body(*a, _fn=block_fn):
                 # runs ONLY while jax traces: an exact compile detector
                 _devobs.COMPILES.mark_traced()
@@ -537,14 +587,16 @@ class MeshExecutor:
         docs/ingest.md) does NOT rebuild the stack; the epochs vector
         tells ``_placed_groups`` which journal chunks to overlay in.
         Any non-ingest mutation re-anchors device_gen = gen and the
-        token mismatch rebuilds as before.  The device form rides
+        token mismatch rebuilds as before.  The FULL signature rides
         along: a budget-limit change can flip a fragment between dense
-        and compressed residency, and a stale-form stack would silently
-        keep the old footprint."""
+        and compressed residency, and a container-kernels flip changes
+        the compressed signature's backend axis — either way a stale
+        stack would feed plans keyed on signatures the current config
+        no longer produces, so the token mismatch rebuilds it."""
         frags = [[holder.fragment(index, field, view, shard)
                   for field, view in keys] for shard in shards]
         token = tuple(
-            -1 if fr is None else (fr.device_gen, self._frag_sig(fr)[0])
+            -1 if fr is None else (fr.device_gen, self._frag_sig(fr))
             for row in frags for fr in row)
         epochs = tuple(
             0 if fr is None else fr.ingest_epoch
@@ -754,7 +806,7 @@ class MeshExecutor:
         Transfers move compressed bytes, so there is no warm-mirror
         stacking variant — re-shipping a packed stream is already far
         cheaper than a dense stack ever was."""
-        _z, _rows, cb, pb, _ab, _rb = sig
+        cb, pb = sig[2], sig[3]
         n = len(frs)
         bucket = self._bucket(n)
         keys = np.full((bucket, cb), -1, dtype=np.int32)
@@ -1076,7 +1128,25 @@ class MeshExecutor:
                 # loop-local captures frozen as defaults (re-trace safety;
                 # see segments_batch)
                 def per_shard(params_, *arrays, _layout=layout,
-                              _k0=pkeys[0]):
+                              _k0=pkeys[0],
+                              _fused=_fused_entry(layout, pkeys[0])):
+                    if _fused is not None:
+                        # the headline fusion (ops/kernels.py): decode +
+                        # filter-AND + per-row popcount in ONE Pallas
+                        # kernel; the field fragment's dense words never
+                        # leave the kernel's VMEM tile.  Other layout
+                        # entries still decode normally for the filter
+                        # plan (XLA drops the unused field decode).
+                        from ..ops import kernels
+                        i0, fs = _fused
+                        filt = None
+                        if fplan is not None:
+                            frags = _unpack_frags(_layout, arrays)
+                            filt = eval_plan(fplan, frags, params_)
+                        return kernels.fused_row_counts(
+                            *arrays[i0: i0 + 5], filt, rows=fs[1],
+                            words=SHARD_WORDS, a_bucket=fs[4],
+                            r_bucket=fs[5])        # [rows]
                     frags = _unpack_frags(_layout, arrays)
                     frag = frags[_k0]              # [rows, W]
                     if fplan is None:

@@ -54,7 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..core import SHARD_WORDS
+from ..core import CONTAINER_WORDS, SHARD_WORDS
 from ..executor.plan import eval_plan, plan_inputs
 from ..ops import bsi
 from ..utils import devobs as _devobs
@@ -215,7 +215,9 @@ class _InstrumentedWhole:
             tickets=ctx.get("tickets", 1),
             dispatch_s=dt, compiled=compiled,
             decode_bytes=m.get("decode_bytes", 0),
-            slice_pos=_devobs.current_slice())
+            slice_pos=_devobs.current_slice(),
+            kernel_launches=m.get("kernel_launches", 0),
+            kernel_tiles=m.get("kernel_tiles", 0))
         prof = qprof.current()
         if prof is not None:
             # rows/padding/decode tags feed the EXPLAIN launches section
@@ -420,9 +422,20 @@ class WholeQueryRunner:
                 mesh._cache[key] = fn
 
         flat_all = [a for g in live for a in g[2]]
+        from ..ops import kernels as _kernels
         decode_bytes = sum(
             bucket * sum(s[1] * SHARD_WORDS * 4
-                         for _, n, s in g[3] if n > 1)
+                         for _, n, s in g[3]
+                         if n > 1 and _kernels.sig_backend(s) != "pallas")
+            for bucket, g in zip(buckets, live))
+        kernel_launches = sum(
+            bucket * sum(1 for _, n, s in g[3]
+                         if n > 1 and _kernels.sig_backend(s) == "pallas")
+            for bucket, g in zip(buckets, live))
+        kernel_tiles = sum(
+            bucket * sum(s[1] * (SHARD_WORDS // CONTAINER_WORDS)
+                         for _, n, s in g[3]
+                         if n > 1 and _kernels.sig_backend(s) == "pallas")
             for bucket, g in zip(buckets, live))
         launch_meta = {
             "shards": sum(len(g[0]) for g in live),
@@ -430,6 +443,8 @@ class WholeQueryRunner:
             "rows": sum(actual_b),
             "rows_padded": sum(_mat_rows(m) for m in pad_mats),
             "decode_bytes": decode_bytes,
+            "kernel_launches": kernel_launches,
+            "kernel_tiles": kernel_tiles,
         }
         sharding = NamedSharding(mesh.mesh, P())
         mats_dev = jax.device_put(pad_mats, sharding)
@@ -573,9 +588,16 @@ class WholeQueryRunner:
             return body(mats, *flat)
 
         n_flat_all = sum(n for _, n in groups_static)
+        from ..ops import kernels as _kernels
+        # shard_map's replication checker has no rule for pallas_call;
+        # disable it only when a group actually decodes through the
+        # Pallas backend (mesh_exec._jit_shard_map does the same)
+        check = not any(
+            n > 1 and _kernels.sig_backend(s) == "pallas"
+            for layout_g, _ in groups_static for _, n, s in layout_g)
         fn = jax.jit(_shard_map(
             traced, mesh=self.mesh.mesh,
             in_specs=(P(),) + (P(SHARD_AXIS),) * n_flat_all,
             out_specs=tuple(out_specs),
-            **{_SM_CHECK_KW: True}))
+            **{_SM_CHECK_KW: check}))
         return _InstrumentedWhole(fn, key, out_index)

@@ -76,6 +76,11 @@ class Config:
     # Per-launch dense decode workspace ceiling (MB): shard slices are
     # cut so one launch never decodes more dense tile bytes than this.
     decode_workspace_mb: int = 1024
+    # Container decode backend (ops/kernels.py): "auto" picks the fused
+    # Pallas kernels on TPU and the jnp decode elsewhere; "pallas"
+    # forces the kernels (interpreted off-TPU — the differential-test
+    # mode); "jnp" is the kill switch restoring the pure-XLA decode.
+    container_kernels: str = "auto"
     # -- streaming ingest (docs/ingest.md) ---------------------------------
     # Group-commit window: milliseconds the committer lets submissions
     # coalesce before flushing (one WAL frame + one gen bump + one
@@ -339,6 +344,7 @@ class Config:
                                                 float),
             "PILOSA_TPU_DECODE_WORKSPACE_MB": ("decode_workspace_mb",
                                                int),
+            "PILOSA_TPU_CONTAINER_KERNELS": ("container_kernels", str),
             "PILOSA_TPU_INGEST_FLUSH_MS": ("ingest_flush_ms", float),
             "PILOSA_TPU_INGEST_DELTA_MB": ("ingest_delta_mb", int),
             "PILOSA_TPU_INGEST_MAX_FRAME_MB": ("ingest_max_frame_mb",
@@ -442,6 +448,7 @@ class Config:
             "compressed-resident": "compressed_resident",
             "compress-max-density": "compress_max_density",
             "decode-workspace-mb": "decode_workspace_mb",
+            "container-kernels": "container_kernels",
             "ingest-flush-ms": "ingest_flush_ms",
             "ingest-delta-mb": "ingest_delta_mb",
             "ingest-max-frame-mb": "ingest_max_frame_mb",
@@ -551,6 +558,11 @@ class Server:
         from ..parallel import mesh_exec as _mesh_exec
         _mesh_exec.DECODE_WORKSPACE_BYTES = \
             max(self.config.decode_workspace_mb, 1) << 20
+        # container-kernels backend selector (ops/kernels.py); the
+        # resolved backend rides compressed device signatures, so a
+        # change rebuilds stacks/executables rather than retracing
+        from ..ops import kernels as _kernels
+        _kernels.CONTAINER_KERNELS = str(self.config.container_kernels)
         # batch-temp workspace (docs/observability.md satellite of the
         # decode-workspace pattern): bounds fused/batched [B, rows, W]
         # device temps; process-wide, most recent Server wins
@@ -1092,6 +1104,12 @@ class Server:
                          led["decodePeakBytes"])
         self.stats.gauge("device.decode_workspace_limit_bytes",
                          _mesh_exec.DECODE_WORKSPACE_BYTES)
+        self.stats.gauge("device.kernel_launches", led["kernelLaunches"])
+        from ..ops import kernels as _kernels
+        # resolved backend as a 0/1 flag gauge (1 = pallas kernels
+        # active), the scrape-friendly encoding of a string state
+        self.stats.gauge("device.kernel_backend",
+                         1 if _kernels.resolve() == "pallas" else 0)
 
     def drain(self, timeout: float | None = None) -> bool:
         """Graceful drain: stop ADMITTING public queries (new ones get

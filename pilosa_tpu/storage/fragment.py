@@ -1198,22 +1198,30 @@ class Fragment:
     def device_sig(self) -> tuple:
         """Stacked-group shape signature for the mesh executor: dense
         fragments keep the (rows, words) tensor shape; compressed ones
-        carry ('z', rows, C, P, A, R) with pow2-bucketed container,
-        payload, array-entry and run counts so one compiled decode
-        executable serves every fragment in a bucket."""
+        carry ('z', rows, C, P, A, R, backend) with pow2-bucketed
+        container, payload, array-entry and run counts so one compiled
+        decode executable serves every fragment in a bucket.  The
+        trailing element is the RESOLVED container-kernels backend
+        (ops/kernels.py): the decode code compiled into the executable
+        is part of its shape, so a knob flip mints new signatures —
+        new plans, new stacks, fresh compiles — instead of replaying a
+        jnp-compiled program through the pallas path (the PR 7 retrace
+        class)."""
         if self.device_form() == "dense":
             return (self.n_rows, SHARD_WORDS)
+        from ..ops import kernels
         from ..ops.containers import pow2_bucket
+        backend = kernels.sig_tag()
         with self._lock:
             s = self._psig
-            if s is not None and s[0] == self.device_gen:
+            if s is not None and s[0] == (self.device_gen, backend):
                 return s[1]
         p = self.packed_host()
         sig = ("z", self.n_rows, pow2_bucket(p.keys.size),
                pow2_bucket(p.payload.size), pow2_bucket(p.a_max),
-               pow2_bucket(p.r_max))
+               pow2_bucket(p.r_max), backend)
         with self._lock:
-            self._psig = (self.device_gen, sig)
+            self._psig = ((self.device_gen, backend), sig)
         return sig
 
     def packed_stats(self) -> dict | None:

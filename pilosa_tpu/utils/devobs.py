@@ -258,6 +258,12 @@ class LaunchLedger:
         self.rows_padded_total = 0
         self.decode_peak_bytes = 0   # high-watermark of per-launch decode
         self.decode_bytes_total = 0
+        # Pallas container-kernel accounting (ops/kernels.py): launches
+        # that embedded fused decode kernels, and the VMEM container
+        # tiles those kernels walked — decode bytes measured as tile
+        # traffic instead of an XLA temp watermark
+        self.kernel_launches_total = 0
+        self.kernel_tiles_total = 0
         # exported as pilosa_tpu_device_* histogram families at /metrics
         # (own exposition like the batcher's, outside the stats client)
         self.launch_hist = BucketHistogram(
@@ -280,7 +286,8 @@ class LaunchLedger:
                shards_padded: int, batch_rows: int,
                batch_rows_padded: int, queue_s: float, dispatch_s: float,
                decode_bytes: int, compiled: bool, tickets: int = 1,
-               slice_pos: tuple | None = None):
+               slice_pos: tuple | None = None, kernel_launches: int = 0,
+               kernel_tiles: int = 0):
         actual = max(shards, 0) * max(batch_rows, 1)
         total = max(shards_padded, shards) * max(batch_rows_padded,
                                                  batch_rows, 1)
@@ -297,6 +304,9 @@ class LaunchLedger:
         if slice_pos is not None:
             entry["slice"] = slice_pos[0]
             entry["slices"] = slice_pos[1]
+        if kernel_launches:
+            entry["kernelLaunches"] = kernel_launches
+            entry["kernelTiles"] = kernel_tiles
         with self._lock:
             self._ring.append(entry)
             self.launches_total += 1
@@ -305,6 +315,8 @@ class LaunchLedger:
             self.decode_bytes_total += decode_bytes
             self.decode_peak_bytes = max(self.decode_peak_bytes,
                                          decode_bytes)
+            self.kernel_launches_total += kernel_launches
+            self.kernel_tiles_total += kernel_tiles
         self.launch_hist.observe(dispatch_s)
         if queue_s > 0:
             self.queue_hist.observe(queue_s)
@@ -332,6 +344,8 @@ class LaunchLedger:
                     self.rows_padded_total / total, 4) if total else 0.0,
                 "decodePeakBytes": self.decode_peak_bytes,
                 "decodeBytesTotal": self.decode_bytes_total,
+                "kernelLaunches": self.kernel_launches_total,
+                "kernelTiles": self.kernel_tiles_total,
                 "size": self.size,
             }
 

@@ -69,8 +69,15 @@ python -m pilosa_tpu.analysis
 # its every-length truncation / every-byte corruption recovery — and
 # the guarantee that NO corpus state can fail READY — is a crash-safety
 # contract, not a perf test.
+# The container-kernel suite (docs/architecture.md "On native code and
+# Pallas") rides with the decode differential above: the Pallas decode
+# and fused-popcount kernels are a THIRD way to materialize every
+# compressed answer, so the per-form goldens vs the unpack_packed
+# oracle and the dense/jnp/pallas three-leg byte-identity run are the
+# same silent-corruption gate as the PR 7 codec round-trip.
 JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' -p no:cacheprovider \
     tests/test_durability.py tests/test_crash.py tests/test_containers.py \
+    tests/test_kernels.py \
     tests/test_device_obs.py tests/test_ingest.py tests/test_wholequery.py \
     tests/test_routing.py tests/test_churn.py \
     tests/test_events.py tests/test_explain.py tests/test_cluster_obs.py \

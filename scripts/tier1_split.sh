@@ -36,6 +36,7 @@ tests/test_executor.py
 tests/test_explain.py
 tests/test_fuzz.py
 tests/test_ingest.py
+tests/test_kernels.py
 tests/test_native.py
 tests/test_observability.py
 tests/test_pql.py

@@ -126,3 +126,14 @@ def test_bench_smoke_resident_and_budgeted():
     assert rs["retraces_during_warm"] == 0
     assert rs["warm_first_ms"] < rs["cold_first_ms"]
     assert rs["steady_ms"] > 0 and rs["warm_vs_cold"] > 1
+    # container-kernel leg (docs/architecture.md "On native code and
+    # Pallas"): the SSB corpus answered byte-identically across dense /
+    # compressed-jnp / compressed-pallas (asserted in bench.py); re-check
+    # that the pallas leg really launched container kernels and the jnp
+    # kill-switch leg launched none
+    ssb = data["ssb"]
+    assert ssb["pallas"]["device"]["kernel_backend"] == "pallas"
+    assert ssb["pallas"]["device"]["kernel_launches"] > 0
+    assert ssb["jnp"]["device"]["kernel_backend"] == "jnp"
+    assert ssb["jnp"]["device"]["kernel_launches"] == 0
+    assert ssb["compressed_mb"] > 0

@@ -320,8 +320,9 @@ def test_group_by_grid_bounds_128x128(holder):
 @pytest.mark.slow
 def test_group_by_128x128_grid_single_wave(holder):
     """Full-size 128x128 grid (16384 combos) — the original r4 case.
-    Slow: the grid compile dominates tier-1 wall clock, and the fast
-    72x72 variant above already exceeds the retired 4096-combo cap."""
+    Slow: the grid compile dominates tier-1 wall clock; the fast 24x24
+    variant above covers the full dispatch path and the bounds check
+    covers the retired 4096-combo cap without the compile."""
     _grid_single_wave_case(holder, rows=128, n=20000)
 
 

@@ -1,0 +1,321 @@
+"""Fused Pallas container kernels (ops/kernels.py): per-container-form
+kernel goldens against the ``unpack_packed`` host oracle, the fused
+decode+op+popcount kernel, backend resolution (the ``container-kernels``
+knob and its kill switch), the device_sig kernel-backend axis (a flip
+must rebuild stacks, not retrace — the PR 7 retrace class), and the
+3-LEG DIFFERENTIAL: a mixed-forms corpus executed dense-resident,
+compressed-jnp, and compressed-pallas-interpret must return
+byte-identical results with zero retrace alarms.  Everything runs
+through the Pallas INTERPRETER on the CPU tier-1 platform — the same
+kernel logic a TPU compiles."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import CONTAINER_WORDS, SHARD_WIDTH, SHARD_WORDS
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.ops import containers, kernels
+from pilosa_tpu.ops.containers import (
+    ARRAY_WORDS_MAX, RUN_MAX, pack_words, pad_packed, pow2_bucket,
+    unpack_packed, upload_decode,
+)
+from pilosa_tpu.storage import FieldOptions, Holder
+from pilosa_tpu.storage.fragment import Fragment
+from pilosa_tpu.storage.membudget import DEFAULT_BUDGET, DeviceBudget
+from pilosa_tpu.utils import devobs
+
+from test_differential import _norm, gen_query
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def force_backend():
+    """Set the container-kernels knob for one test, restoring after —
+    the per-test analog of the server config apply."""
+    old = kernels.CONTAINER_KERNELS
+
+    def _set(mode):
+        kernels.CONTAINER_KERNELS = mode
+
+    yield _set
+    kernels.CONTAINER_KERNELS = old
+
+
+def _kernel_golden(idx, val, rows):
+    """Pallas decode (interpret mode on CPU) of a packed stream vs the
+    numpy host oracle; returns the Packed stream for form assertions."""
+    import jax.numpy as jnp
+    p = pack_words(idx, val)
+    arrs = [jnp.asarray(a) for a in pad_packed(p)]
+    got = np.asarray(kernels.decode_block(
+        *arrs, rows=rows, a_bucket=pow2_bucket(p.a_max),
+        r_bucket=pow2_bucket(p.r_max)))
+    np.testing.assert_array_equal(got, unpack_packed(p, rows))
+    return p
+
+
+def _popcounts(dense):
+    return np.unpackbits(
+        np.ascontiguousarray(dense).view(np.uint8), axis=1).sum(
+            axis=1).astype(np.int32)
+
+
+# -- per-container-form kernel goldens vs the host oracle -------------------
+
+def test_kernel_array_boundary(rng):
+    """Array containers right at the array<->bitmap threshold on both
+    sides decode exactly."""
+    for n in (1, ARRAY_WORDS_MAX - 1, ARRAY_WORDS_MAX):
+        slots = np.sort(rng.choice(CONTAINER_WORDS, n, replace=False))
+        idx = (3 * CONTAINER_WORDS + slots).astype(np.int64)
+        val = rng.integers(1, 1 << 32, n, dtype=np.uint64) \
+            .astype(np.uint32)
+        p = _kernel_golden(idx, val, rows=2)
+        assert p.type_histogram()["array"] >= 1
+
+
+def test_kernel_bitmap(rng):
+    """A over-threshold container packs as bitmap and decodes by the
+    kernel's contiguous VMEM copy."""
+    n = ARRAY_WORDS_MAX + 1
+    slots = np.sort(rng.choice(CONTAINER_WORDS, n, replace=False))
+    idx = slots.astype(np.int64)
+    val = rng.integers(1, 1 << 32, n, dtype=np.uint64).astype(np.uint32)
+    p = _kernel_golden(idx, val, rows=1)
+    assert p.type_histogram()["bitmap"] == 1
+
+
+def test_kernel_run_boundary():
+    """Run containers at RUN_MAX runs (and the single full-container
+    run) decode via the kernel's range masks exactly."""
+    # RUN_MAX disjoint 3-word runs of all-ones words (long enough that
+    # 2 payload words per run beats the array form's 2 per word)
+    idx = (np.arange(RUN_MAX)[:, None] * 4
+           + np.arange(3)[None, :]).reshape(-1).astype(np.int64)
+    val = np.full(idx.size, 0xFFFFFFFF, dtype=np.uint32)
+    p = _kernel_golden(idx, val, rows=1)
+    assert p.type_histogram()["run"] == 1
+    # one full container of ones -> a single run
+    idx2 = np.arange(CONTAINER_WORDS, dtype=np.int64) + CONTAINER_WORDS
+    val2 = np.full(CONTAINER_WORDS, 0xFFFFFFFF, dtype=np.uint32)
+    p2 = _kernel_golden(idx2, val2, rows=1)
+    assert p2.type_histogram()["run"] == 1
+    assert int(p2.counts[p2.types == containers.TYPE_RUN][0]) == 1
+
+
+def test_kernel_empty_and_mixed(rng):
+    """Empty stream (falls back to jnp zeros) and a mixed-form fragment
+    spanning several rows."""
+    _kernel_golden(np.zeros(0, np.int64), np.zeros(0, np.uint32), rows=2)
+    rows = 4
+    parts_i, parts_v = [], []
+    # sparse scatter (arrays) across all rows
+    i0 = np.sort(rng.choice(rows * SHARD_WORDS, 400, replace=False))
+    parts_i.append(i0.astype(np.int64))
+    parts_v.append(rng.integers(1, 1 << 32, 400, dtype=np.uint64)
+                   .astype(np.uint32))
+    # a dense container (bitmap) in row 1
+    i1 = SHARD_WORDS + 7 * CONTAINER_WORDS + np.arange(CONTAINER_WORDS)
+    parts_i.append(i1.astype(np.int64))
+    parts_v.append(rng.integers(1, 1 << 32, CONTAINER_WORDS,
+                                dtype=np.uint64).astype(np.uint32))
+    # a run container (all ones) in row 3
+    i2 = 3 * SHARD_WORDS + 2 * CONTAINER_WORDS + np.arange(CONTAINER_WORDS)
+    parts_i.append(i2.astype(np.int64))
+    parts_v.append(np.full(CONTAINER_WORDS, 0xFFFFFFFF, dtype=np.uint32))
+    flat = np.concatenate(parts_i)
+    vals = np.concatenate(parts_v)
+    order = np.argsort(flat)
+    flat, vals = flat[order], vals[order]
+    keep = np.concatenate([[True], np.diff(flat) != 0])
+    p = _kernel_golden(flat[keep], vals[keep], rows=rows)
+    h = p.type_histogram()
+    assert h["array"] and h["bitmap"] and h["run"]
+
+
+def test_fused_row_counts_golden(rng):
+    """The headline fusion (decode + AND + popcount in one kernel)
+    matches the host oracle, filtered and unfiltered."""
+    import jax.numpy as jnp
+    rows = 3
+    flat = np.sort(rng.choice(rows * SHARD_WORDS, 900, replace=False)) \
+        .astype(np.int64)
+    vals = rng.integers(1, 1 << 32, 900, dtype=np.uint64) \
+        .astype(np.uint32)
+    p = pack_words(flat, vals)
+    arrs = [jnp.asarray(a) for a in pad_packed(p)]
+    ab, rb = pow2_bucket(p.a_max), pow2_bucket(p.r_max)
+    dense = unpack_packed(p, rows)
+    got = np.asarray(kernels.fused_row_counts(
+        *arrs, None, rows=rows, a_bucket=ab, r_bucket=rb))
+    np.testing.assert_array_equal(got, _popcounts(dense))
+    filt = rng.integers(0, 1 << 32, SHARD_WORDS, dtype=np.uint64) \
+        .astype(np.uint32)
+    got_f = np.asarray(kernels.fused_row_counts(
+        *arrs, jnp.asarray(filt), rows=rows, a_bucket=ab, r_bucket=rb))
+    np.testing.assert_array_equal(got_f,
+                                  _popcounts(dense & filt[None, :]))
+
+
+def test_vmem_budget_rule_falls_back(rng, monkeypatch):
+    """A bucket whose working set exceeds the VMEM budget rule must
+    take the jnp fallback — and still be exact (the rule is a schedule
+    choice, never a correctness choice)."""
+    monkeypatch.setattr(kernels, "VMEM_TILE_BUDGET_BYTES", 1024)
+    assert not kernels.fits_vmem(1 << 20, 0, 0)
+    flat = np.sort(rng.choice(SHARD_WORDS, 64, replace=False)) \
+        .astype(np.int64)
+    vals = rng.integers(1, 1 << 32, 64, dtype=np.uint64) \
+        .astype(np.uint32)
+    _kernel_golden(flat, vals, rows=1)
+
+
+# -- backend resolution and the device_sig backend axis ---------------------
+
+def test_resolve_backends(force_backend):
+    """Knob semantics: jnp is the kill switch, pallas forces the
+    kernels, auto picks by platform (jnp on the CPU tier-1 box)."""
+    import jax
+    force_backend("jnp")
+    assert kernels.resolve() == "jnp"
+    force_backend("pallas")
+    assert kernels.resolve() == "pallas"
+    force_backend("auto")
+    want = "pallas" if jax.default_backend() == "tpu" else "jnp"
+    assert kernels.resolve() == want
+    assert kernels.interpret_mode() == (jax.default_backend() != "tpu")
+
+
+def test_device_sig_backend_axis(force_backend):
+    """Satellite regression (the PR 7 retrace class): flipping
+    container-kernels changes a compressed fragment's device_sig — new
+    signatures mean new plan keys and stack tokens, so the flip rebuilds
+    instead of replaying a jnp-compiled executable with pallas-shaped
+    expectations.  Dense signatures carry no backend axis."""
+    budget = DeviceBudget(limit_bytes=64 << 20)
+    f = Fragment(None, "i", "f", "standard", 0, budget=budget)
+    f.bulk_import(np.arange(8), np.arange(8) * 1000)
+    assert f.device_form() == "compressed"
+    force_backend("jnp")
+    sig_jnp = f.device_sig()
+    assert sig_jnp[0] == "z" and sig_jnp[6] == "jnp"
+    force_backend("pallas")
+    sig_pl = f.device_sig()
+    assert sig_pl[6] == "pallas" and sig_pl[:6] == sig_jnp[:6]
+    # the sig cache is keyed by (gen, backend): flipping back must
+    # return the jnp sig again, not the cached pallas one
+    force_backend("jnp")
+    assert f.device_sig() == sig_jnp
+    assert kernels.sig_backend(sig_pl) == "pallas"
+    # pre-backend-axis 6-tuples read as jnp (the decode they compiled)
+    assert kernels.sig_backend(sig_jnp[:6]) == "jnp"
+
+
+def test_upload_decode_pallas_ledger(force_backend):
+    """The standalone compressed-upload decode honors the knob and
+    registers its kernel launch in the launch ledger."""
+    force_backend("pallas")
+    rng = np.random.default_rng(3)
+    flat = np.sort(rng.choice(2 * SHARD_WORDS, 120, replace=False)) \
+        .astype(np.int64)
+    vals = rng.integers(1, 1 << 32, 120, dtype=np.uint64) \
+        .astype(np.uint32)
+    p = pack_words(flat, vals)
+    before = devobs.LEDGER.kernel_launches_total
+    got = np.asarray(upload_decode(p, 2))
+    np.testing.assert_array_equal(got, unpack_packed(p, 2))
+    assert devobs.LEDGER.kernel_launches_total > before
+
+
+# -- 3-leg differential on the mixed-forms corpus ---------------------------
+
+@pytest.fixture(scope="module")
+def corpus():
+    """4-shard index mixing sparse scatter (arrays), boundary-dense
+    containers (bitmaps), run-heavy clustered ranges, BSI values, and an
+    emptied fragment — the PR 7 mixed corpus at a size the interpreted
+    kernels execute quickly."""
+    rng = np.random.default_rng(99)
+    h = Holder(None)
+    idx = h.create_index("k")
+    a = idx.create_field("a")
+    b = idx.create_field("b")
+    v = idx.create_field("v", FieldOptions(type="int", min=-500, max=500))
+    n = 12_000
+    cols = rng.integers(0, 4 * SHARD_WIDTH, size=n)
+    a.import_bits(rng.integers(0, 10, size=n), cols)
+    b.import_bits(rng.integers(0, 6, size=n), cols)
+    # run-heavy clustered ranges in every shard
+    run_cols = np.concatenate([
+        np.arange(s * SHARD_WIDTH + 1000, s * SHARD_WIDTH + 30_000)
+        for s in range(4)])
+    a.import_bits(np.full(run_cols.size, 11), run_cols)
+    vcols = np.unique(cols[: n // 2])
+    v.import_values(vcols, rng.integers(-500, 500, size=vcols.size))
+    idx.add_existence(np.unique(np.concatenate([cols, run_cols])))
+    # emptied fragment: set then clear (empty packed stream)
+    ecols = np.arange(2 * SHARD_WIDTH + 50, 2 * SHARD_WIDTH + 80)
+    b.import_bits(np.full(30, 5), ecols)
+    b.import_bits(np.full(30, 5), ecols, clear=True)
+    return h
+
+
+def _run_corpus(ex, queries):
+    return [_norm(r) for q in queries for r in ex.execute("k", q)]
+
+
+def test_three_leg_differential(corpus, force_backend):
+    """dense-resident / compressed-jnp / compressed-pallas-interpret
+    are byte-identical on the mixed corpus; the pallas leg records
+    kernel launches in the ledger; and the whole run — including the
+    backend flip — raises ZERO retrace alarms (flips mint new
+    signatures, they don't retrace old ones)."""
+    qrng = np.random.default_rng(1234)
+    queries = [gen_query(qrng) for _ in range(3)]
+    queries += ["TopN(a, n=3)", "Count(Row(a=11))", "Row(b=5)",
+                "Count(Intersect(Row(a=11), Row(b=2)))",
+                "Sum(Row(a=1), field=v)"]
+    ex = Executor(corpus, use_mesh=True)
+    old = DEFAULT_BUDGET.limit_bytes
+    retraces0 = devobs.COMPILES.totals()["retraces"]
+    try:
+        # leg 1 — dense-resident reference (no budget limit, no
+        # compression, backend knob irrelevant)
+        DEFAULT_BUDGET.limit_bytes = None
+        force_backend("jnp")
+        want = _run_corpus(ex, queries)
+
+        # leg 2 — compressed residency, jnp decode (the PR 7 path);
+        # the kill-switch leg must not launch any container kernel
+        DEFAULT_BUDGET.limit_bytes = 256 << 20
+        DEFAULT_BUDGET.shrink_to_limit()
+        kj = devobs.LEDGER.kernel_launches_total
+        assert _run_corpus(ex, queries) == want
+        st = DEFAULT_BUDGET.stats()
+        assert st["compressedBytes"] > 0, \
+            "corpus never compressed: the differential exercised " \
+            "only the dense path"
+        assert devobs.LEDGER.kernel_launches_total == kj, \
+            "jnp kill-switch leg launched container kernels"
+
+        # leg 3 — compressed residency, Pallas kernels (interpreted on
+        # CPU): same bytes, plus kernel launches in the ledger
+        force_backend("pallas")
+        k0 = devobs.LEDGER.kernel_launches_total
+        assert _run_corpus(ex, queries) == want
+        assert devobs.LEDGER.kernel_launches_total > k0, \
+            "pallas leg never launched a container kernel"
+
+        # flip back: the kill switch restores the jnp path in place
+        force_backend("jnp")
+        assert _run_corpus(ex, queries) == want
+    finally:
+        DEFAULT_BUDGET.limit_bytes = old
+        ex.close()
+    assert devobs.COMPILES.totals()["retraces"] == retraces0, \
+        "backend flip retraced an existing signature instead of " \
+        "minting new ones"
