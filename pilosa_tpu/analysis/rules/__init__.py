@@ -2,6 +2,7 @@
 astlint registry (one module per rule, docs/static-analysis.md)."""
 
 from . import (  # noqa: F401
+    alert_names,
     batcher_bypass,
     event_names,
     except_swallow,

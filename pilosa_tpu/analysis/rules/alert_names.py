@@ -1,0 +1,87 @@
+"""alert-names: two-way alert_rule <-> docs/observability.md catalog.
+
+Alert-rule ids are the paging contract: an operator woken by
+``ALERT fire [page] slo-latency-burn`` must find a catalog row that
+says what the alert means and — crucially — WHERE TO LOOK, so every
+row's runbook line must name a ``/debug`` surface.  The lint is
+two-way like event-names: a registered rule with no catalog row is an
+unexplained page; a catalog row matching no ``alert_rule("...")``
+registration documents an alert that can never fire.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astlint import Finding, project_rule
+
+CATALOG = re.compile(r"<!-- alerts-catalog:begin -->(.*?)"
+                     r"<!-- alerts-catalog:end -->", re.S)
+
+
+def _rule_sites(mod):
+    """(id, line) for every literal ``alert_rule("...")`` decorator or
+    call in a module (utils/slo.py today, but the lint is site-agnostic
+    so subsystem-local rules stay covered)."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else \
+            f.attr if isinstance(f, ast.Attribute) else None
+        if name != "alert_rule":
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            yield node.args[0].value, node.lineno
+
+
+@project_rule("alert-names")
+def check(modules, root):
+    """alert_rule id missing a catalog row / row with no registration /
+    row whose runbook names no /debug surface."""
+    code: dict[str, tuple[str, int]] = {}
+    for rel, mod in modules.items():
+        if not rel.startswith("pilosa_tpu"):
+            continue
+        if rel.startswith("pilosa_tpu/analysis/"):
+            continue  # the analyzer's own docs show ids on purpose
+        for rid, line in _rule_sites(mod):
+            code.setdefault(rid, (rel, line))
+    if not code:
+        return  # SLO engine absent: nothing to check against
+
+    doc_path = root / "docs" / "observability.md"
+    doc_rel = "docs/observability.md"
+    if not doc_path.is_file():
+        yield Finding("alert-names", doc_rel, 1,
+                      "docs/observability.md is missing")
+        return
+    doc_text = doc_path.read_text()
+    m = CATALOG.search(doc_text)
+    if m is None:
+        yield Finding("alert-names", doc_rel, 1,
+                      "missing the alerts-catalog markers")
+        return
+    cat_line = doc_text.count("\n", 0, m.start()) + 1
+    rows: dict[str, str] = {}
+    for row in re.finditer(r"^\| `([^`]+)`(.*)$", m.group(1), re.M):
+        rows[row.group(1)] = row.group(2)
+
+    for rid in sorted(code):
+        if rid not in rows:
+            rel, line = code[rid]
+            yield Finding("alert-names", rel, line,
+                          f"alert rule '{rid}' is registered but missing "
+                          f"from the docs/observability.md alerts catalog")
+    for rid in sorted(rows):
+        if rid not in code:
+            yield Finding("alert-names", doc_rel, cat_line,
+                          f"alerts-catalog row '{rid}' matches no "
+                          f"alert_rule registration")
+        elif "/debug" not in rows[rid]:
+            yield Finding("alert-names", doc_rel, cat_line,
+                          f"alerts-catalog row '{rid}' has no runbook "
+                          f"surface — the row must name a /debug "
+                          f"endpoint to look at")

@@ -472,6 +472,18 @@ def cmd_top(args) -> int:
                   f"launches {lau.get('launches', 0)}  "
                   f"padding {100 * lau.get('paddingWasteRatio', 0):.1f}%  "
                   f"decode peak {lau.get('decodePeakBytes', 0) // mb}MB")
+            # container-kernel plane: the resolved backend rides the
+            # device.kernel_backend 0/1 gauge (1 = pallas)
+            kb = (v.get("gauges") or {}).get("device.kernel_backend")
+            print(f"   kernels: backend "
+                  f"{'-' if kb is None else 'pallas' if kb else 'jnp'}  "
+                  f"launches {lau.get('kernelLaunches', 0)}  "
+                  f"tiles {lau.get('kernelTiles', 0)}")
+            active = (v.get("alerts") or {}).get("active") or {}
+            if active:
+                print("   !! ALERTS: " + "  ".join(
+                    f"{aid}[{a.get('severity')}]"
+                    for aid, a in sorted(active.items())))
             warm = v.get("warmup") or {}
             if warm.get("phase") == "warming":
                 print(f"   WARMING: {warm.get('replayed', 0)}"
@@ -497,6 +509,65 @@ def cmd_top(args) -> int:
             _time.sleep(args.interval)
     except KeyboardInterrupt:
         return 0
+
+
+def cmd_alerts(args) -> int:
+    """Render /debug/alerts: objectives, burn-rate windows, the active
+    alert table, and recent fire/resolve transitions
+    (docs/observability.md "SLOs & alerting")."""
+    base = _base_url(args.host)
+    a = _http("GET", f"{base}/debug/alerts")
+    if not a.get("enabled"):
+        print("alert evaluation disabled (alert-rules = \"off\" "
+              "or the time-series sampler is off)")
+        return 0
+    w = a.get("windows") or {}
+    print(f"-- pilosa-tpu alerts @ {args.host}  "
+          f"target {a.get('target')}  "
+          f"latency-slo {a.get('latencyMs')}ms  "
+          f"burn >{a.get('burnThreshold')}x  "
+          f"windows {w.get('fastS')}s/{w.get('slowS')}s")
+    print(f"   evaluations {a.get('evaluations', 0)}  "
+          f"fired {a.get('firedTotal', 0)}  "
+          f"resolved {a.get('resolvedTotal', 0)}")
+    active = a.get("active") or {}
+    if not active:
+        print("   no active alerts")
+    for aid, al in sorted(active.items()):
+        print(f"   ACTIVE [{al.get('severity')}] {aid}  "
+              f"for {al.get('durationS', 0):.0f}s  "
+              f"{al.get('detail', '')}")
+    hist = (a.get("history") or [])[-args.history:]
+    if hist:
+        import time as _time
+        print("   -- recent transitions")
+        for h in hist:
+            when = _time.strftime("%H:%M:%S",
+                                  _time.localtime(h.get("wall", 0)))
+            extra = h.get("detail", "") \
+                if h.get("action") == "fire" else ""
+            print(f"   {when} {h.get('action'):<7} "
+                  f"[{h.get('severity')}] {h.get('id')}  {extra}")
+    rec = a.get("flightRecorder")
+    if rec:
+        last = rec.get("last") or {}
+        print(f"   flight recorder: {rec.get('captures', 0)} bundles  "
+              f"{rec.get('diskBytes', 0) >> 20}MB"
+              f"/{rec.get('budgetMb', 0)}MB"
+              + (f"  last {last.get('path')}" if last else ""))
+    return 0
+
+
+def cmd_bundle(args) -> int:
+    """POST /debug/bundle: capture an on-demand flight-recorder
+    diagnostic bundle and print where it landed."""
+    base = _base_url(args.host)
+    out = _http("POST", f"{base}/debug/bundle",
+                json.dumps({"reason": args.reason}).encode())
+    last = out.get("last") or {}
+    print(f"bundle written: {out.get('path')} "
+          f"({last.get('bytes', 0) >> 10} KiB)")
+    return 0
 
 
 DEFAULT_CONFIG = """\
@@ -572,6 +643,15 @@ max-op-n = 10000
 #                          # (length+CRC framed JSON records)
 # batch-temp-mb = 4096     # per-launch batch-temp workspace for fused
 #                          # [B, rows, W] row_counts/TopN device temps
+# SLOs & alerting (docs/observability.md "SLOs & alerting")
+# slo-latency-ms = 500     # latency objective: queries over this are
+#                          # SLO-bad for the burn-rate evaluator
+# slo-target = 0.999       # good-fraction objective for availability
+#                          # and latency SLOs
+# alert-rules = "all"      # "all", "off", or a comma list of rule ids
+#                          # (catalog in docs/observability.md)
+# flight-recorder-mb = 64  # on-alert diagnostic bundle disk budget
+#                          # under <data-dir>/flightrec, 0 = off
 # warm start (docs/warmup.md)
 # compile-cache-dir = ""   # persistent XLA compile cache; "" =
 #                          # <data-dir>/.compile-cache, "off" disables
@@ -663,6 +743,10 @@ def cmd_config(args) -> int:
     print(f"event-journal-size = {cfg.event_journal_size}")
     print(f"event-log = {str(cfg.event_log).lower()}")
     print(f"batch-temp-mb = {cfg.batch_temp_mb}")
+    print(f"slo-latency-ms = {cfg.slo_latency_ms}")
+    print(f"slo-target = {cfg.slo_target}")
+    print(f"alert-rules = {q(cfg.alert_rules)}")
+    print(f"flight-recorder-mb = {cfg.flight_recorder_mb}")
     print()
     print("[cluster]")
     print(f"hosts = [{', '.join(q(h) for h in cfg.cluster_hosts)}]")
@@ -774,6 +858,22 @@ def main(argv=None) -> int:
     sp.add_argument("--events", type=int, default=8,
                     help="timeline entries shown per --cluster poll")
     sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser("alerts",
+                        help="show the SLO engine's alert state "
+                             "(/debug/alerts)")
+    sp.add_argument("-host", default="localhost:10101")
+    sp.add_argument("--history", type=int, default=16,
+                    help="recent fire/resolve transitions shown")
+    sp.set_defaults(fn=cmd_alerts)
+
+    sp = sub.add_parser("bundle",
+                        help="capture an on-demand flight-recorder "
+                             "diagnostic bundle (POST /debug/bundle)")
+    sp.add_argument("-host", default="localhost:10101")
+    sp.add_argument("--reason", default="manual",
+                    help="reason tag embedded in the bundle filename")
+    sp.set_defaults(fn=cmd_bundle)
 
     sp = sub.add_parser("generate-config", help="print default config")
     sp.set_defaults(fn=cmd_generate_config)

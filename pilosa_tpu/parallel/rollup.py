@@ -93,6 +93,14 @@ def summarize_vars(v: dict) -> dict:
         "admissionWaiting": int(adm.get("waiting") or 0),
         "overlayEpoch": int((cl.get("overlay") or {}).get("epoch") or 0),
         "tenants": tenants,
+        # SLO engine (docs/observability.md "SLOs & alerting"): the
+        # per-node alert state the fleet panel and the coordinator's
+        # pilosa_tpu_cluster_active_alerts family render — stale peers
+        # keep their last-known alert set, stamped stale like the rest
+        "activeAlerts": len((v.get("alerts") or {}).get("active") or {}),
+        "alertsFired": int((v.get("alerts") or {}).get("firedTotal")
+                           or 0),
+        "alertIds": sorted((v.get("alerts") or {}).get("active") or {}),
     }
 
 
@@ -336,6 +344,8 @@ class FleetRollup:
             ("quarantinedFragments", "quarantined_fragments"),
             ("ingestBacklogBytes", "ingest_backlog_bytes"),
             ("overlayEpoch", "overlay_epoch"),
+            ("activeAlerts", "active_alerts"),
+            ("alertsFired", "alerts_fired_total"),
         )
         snap = self.snapshot()
         lines = []

@@ -31,6 +31,13 @@ DASHBOARD_HTML = """<!doctype html>
   .axis { color: #6b7077; font-size: 10px; display: flex;
           justify-content: space-between; }
   .err { color: #e07a5f; }
+  #alerts { margin: 0 0 12px; }
+  #alerts:empty { display: none; }
+  .alert { display: inline-block; margin: 0 8px 4px 0; padding: 3px 9px;
+           border-radius: 4px; font-size: 12px; background: #2a1e22;
+           border: 1px solid #f7768e; color: #f7768e; }
+  .alert.ticket { background: #2a2620; border-color: #e0af68;
+                  color: #e0af68; }
 </style>
 </head>
 <body>
@@ -38,6 +45,7 @@ DASHBOARD_HTML = """<!doctype html>
   <a href="/debug/dashboard/cluster" style="font-size:11px;
      color:#7aa2f7; margin-left:10px">fleet view &rarr;</a></h1>
 <div id="meta">loading&hellip;</div>
+<div id="alerts"></div>
 <div id="grid"></div>
 <script>
 "use strict";
@@ -79,6 +87,11 @@ const CHARTS = [
             {label: "handoffs", f: s => s.balancerHandoffsDelta}]},
   {title: "fleet events", unit: "/interval",
    series: [{label: "events", f: s => s.fleetEventsDelta}]},
+  {title: "kernel launches", unit: "/s",
+   series: [{label: "launches", f: (s, dt) => s.kernelLaunchesDelta / dt},
+            {label: "tiles", f: (s, dt) => s.kernelTilesDelta / dt}]},
+  {title: "tenant sheds", unit: "/interval",
+   series: [{label: "sheds", f: s => s.tenantShedsDelta}]},
 ];
 function fmt(v) {
   if (!isFinite(v)) return "-";
@@ -116,6 +129,13 @@ function render(ts, vars) {
     `${s.length}/${ts.capacity} samples (${ts.coveredS}s covered) · ` +
     `queries served ${counts["query"] || 0} · ` +
     `up ${Math.round(last.uptimeS || 0)}s`;
+  const active = ((vars && vars.alerts) || {}).active || {};
+  document.getElementById("alerts").innerHTML =
+    Object.keys(active).sort().map(id => {
+      const a = active[id];
+      return `<span class="alert ${a.severity}" title="${a.detail ||
+        ""}">&#9888; ${id}</span>`;
+    }).join("");
   const grid = document.getElementById("grid");
   grid.innerHTML = "";
   for (const c of CHARTS) {
@@ -198,7 +218,7 @@ CLUSTER_DASHBOARD_HTML = """<!doctype html>
   <th>node</th><th>state</th><th>qps</th><th>p99 ms</th>
   <th>HBM MB</th><th>evict</th><th>retrace</th><th>hedges</th>
   <th>waves</th><th>partial</th><th>quar</th><th>ingest MB</th>
-  <th>stale s</th>
+  <th>alerts</th><th>stale s</th>
 </tr></thead><tbody></tbody></table>
 <h2>fleet timeline</h2>
 <div id="timeline"></div>
@@ -232,6 +252,8 @@ function render(c) {
       n.quarantinedFragments ?
         `<span class="flag">${n.quarantinedFragments}</span>` : 0,
       MB(n.ingestBacklogBytes || 0),
+      n.activeAlerts ? `<span class="down" title="${
+        (n.alertIds || []).join(", ")}">${n.activeAlerts}</span>` : 0,
       n.stale ? `<span class="flag">${
         n.staleS != null ? n.staleS.toFixed(0) : "?"}</span>` : "",
     ];

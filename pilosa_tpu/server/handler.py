@@ -259,6 +259,17 @@ def build_debug_vars(api: API, server=None) -> dict:
             k: snap_ts[k] for k in ("intervalS", "windowS",
                                     "capacity", "samplesTotal",
                                     "coveredS")}
+    # SLOs & alerting (docs/observability.md): the compact active-alert
+    # table — folded into /debug/cluster per node by the fleet rollup;
+    # the full lifecycle view is /debug/alerts
+    slo_eng = getattr(server, "slo", None) if server is not None \
+        else None
+    if slo_eng is not None:
+        out["alerts"] = slo_eng.vars_summary()
+    flightrec = getattr(server, "flightrec", None) if server is not None \
+        else None
+    if flightrec is not None:
+        out["flightRecorder"] = flightrec.snapshot()
     return out
 
 
@@ -788,6 +799,47 @@ def build_router(api: API, server=None) -> Router:
 
     r.add("GET", "/debug/timeseries", debug_timeseries)
 
+    # -- SLOs & alerting (docs/observability.md "SLOs & alerting") ---------
+
+    def debug_alerts(req, args):
+        """SLO engine state (utils/slo.py): objectives, burn-rate
+        windows, the active-alert table with durations, recent
+        fire/resolve transitions, and the evaluated rule list — plus
+        the flight recorder's capture accounting."""
+        slo_eng = getattr(server, "slo", None) if server is not None \
+            else None
+        if slo_eng is None:
+            out = {"enabled": False, "active": {}, "history": [],
+                   "rules": [], "evaluations": 0, "firedTotal": 0,
+                   "resolvedTotal": 0}
+        else:
+            out = slo_eng.snapshot()
+        flightrec = getattr(server, "flightrec", None) \
+            if server is not None else None
+        if flightrec is not None:
+            out["flightRecorder"] = flightrec.snapshot()
+        return out
+
+    r.add("GET", "/debug/alerts", debug_alerts)
+
+    def debug_bundle(req, args):
+        """On-demand flight-recorder capture (``pilosa-tpu bundle``):
+        snapshots every debug surface into one JSON bundle on disk.
+        Bypasses the on-fire rate limit — an operator asking twice
+        wants two bundles."""
+        if server is None or getattr(server, "flightrec", None) is None:
+            raise ApiError(
+                "flight recorder disabled (flight-recorder-mb = 0)")
+        reason = req.json().get("reason", "manual")
+        if not isinstance(reason, str):
+            raise ApiError("reason must be a string")
+        path = server.capture_bundle(reason, force=True)
+        if path is None:
+            raise ApiError("bundle capture failed (see server log)")
+        return {"path": path, "last": server.flightrec.last}
+
+    r.add("POST", "/debug/bundle", debug_bundle)
+
     def debug_dashboard(req, args):
         from .dashboard import DASHBOARD_HTML
         return ("text/html; charset=utf-8", DASHBOARD_HTML)
@@ -1236,6 +1288,11 @@ class _HandlerClass(BaseHTTPRequestHandler):
             self.stats.timing("http.request", dur_s, exemplar=exemplar)
             if gate == "query":
                 self.stats.timing("http.query", dur_s, exemplar=exemplar)
+                if status >= 500:
+                    # availability SLO numerator (utils/slo.py): 5xx
+                    # query responses, sheds and deadline aborts
+                    # included — the client saw a failure either way
+                    self.stats.count("http.query_5xx")
         # per-tenant accounting: latency/qps/error columns for the
         # /debug/vars "tenants" table and the fleet rollup
         tenant = getattr(self, "_tenant", None)

@@ -280,6 +280,24 @@ class Config:
     # still over the ceiling are marked textTruncated and skipped by the
     # replay harvester.
     slow_log_text_max: int = 512
+    # -- SLOs & alerting (docs/observability.md "SLOs & alerting") ---------
+    # Latency objective: the SLO counts an http.query over this many
+    # milliseconds as bad (snapped down to the nearest latency-histogram
+    # bucket edge so the count is exact).
+    slo_latency_ms: float = 500.0
+    # Objective target for BOTH SLOs: the good fraction of http.query
+    # (non-5xx for availability, under slo-latency-ms for latency) the
+    # burn-rate windows are judged against.
+    slo_target: float = 0.999
+    # Alert rules the SLO engine evaluates each time-series interval:
+    # "all", "off", or a comma-separated list of rule ids (the
+    # docs/observability.md alerts catalog).  Evaluation also requires
+    # the time-series ring (timeseries-interval > 0).
+    alert_rules: str = "all"
+    # Disk budget (MB) for flight-recorder diagnostic bundles under
+    # <data-dir>/flightrec, LRU-pruned by file mtime (the compile-cache
+    # discipline).  0 disables the recorder (alerts still fire).
+    flight_recorder_mb: int = 64
     # Per-launch batch-temp workspace ceiling (MB) for fused/batched
     # [B, rows, W] device temps (row_counts/TopN batches): the batch
     # axis chunks when a launch would exceed it (counted
@@ -411,6 +429,10 @@ class Config:
             "PILOSA_TPU_EVENT_JOURNAL_SIZE": ("event_journal_size", int),
             "PILOSA_TPU_EVENT_LOG": ("event_log", lambda s: s == "true"),
             "PILOSA_TPU_SLOW_LOG_TEXT_MAX": ("slow_log_text_max", int),
+            "PILOSA_TPU_SLO_LATENCY_MS": ("slo_latency_ms", float),
+            "PILOSA_TPU_SLO_TARGET": ("slo_target", float),
+            "PILOSA_TPU_ALERT_RULES": ("alert_rules", str),
+            "PILOSA_TPU_FLIGHT_RECORDER_MB": ("flight_recorder_mb", int),
             "PILOSA_TPU_BATCH_TEMP_MB": ("batch_temp_mb", int),
             "PILOSA_TPU_COMPILE_CACHE_DIR": ("compile_cache_dir", str),
             "PILOSA_TPU_COMPILE_CACHE_MB": ("compile_cache_mb", int),
@@ -490,6 +512,10 @@ class Config:
             "event-journal-size": "event_journal_size",
             "event-log": "event_log",
             "slow-log-text-max": "slow_log_text_max",
+            "slo-latency-ms": "slo_latency_ms",
+            "slo-target": "slo_target",
+            "alert-rules": "alert_rules",
+            "flight-recorder-mb": "flight_recorder_mb",
             "batch-temp-mb": "batch_temp_mb",
             "compile-cache-dir": "compile_cache_dir",
             "compile-cache-mb": "compile_cache_mb",
@@ -717,6 +743,32 @@ class Server:
             self.timeseries = TimeSeriesRing(
                 interval_s=self.config.timeseries_interval,
                 window_s=self.config.timeseries_window)
+        # SLO engine + flight recorder (docs/observability.md "SLOs &
+        # alerting"): burn-rate evaluation rides the time-series monitor
+        # thread (one pass per accepted sample — never a query or scrape
+        # path), and a fire transition triggers a rate-limited
+        # diagnostic-bundle capture before the rings rotate the
+        # evidence out.
+        from ..utils.flightrec import FlightRecorder
+        self.flightrec = None
+        if self.config.flight_recorder_mb > 0:
+            self.flightrec = FlightRecorder(
+                os.path.join(data_dir, "flightrec"),
+                budget_mb=self.config.flight_recorder_mb,
+                logger=self.logger, stats=self.stats)
+        from ..utils import tenant as _tenant
+        from ..utils.slo import SLOEngine
+        self.slo = None
+        if self.timeseries is not None:
+            slo = SLOEngine(
+                self.timeseries, self.stats,
+                latency_ms=self.config.slo_latency_ms,
+                target=self.config.slo_target,
+                rules=self.config.alert_rules,
+                logger=self.logger, on_fire=self._on_alert_fire,
+                tenant_registry=_tenant.REGISTRY)
+            if slo.enabled:
+                self.slo = slo
         # Warm-start subsystem (docs/warmup.md): persistent XLA compile
         # cache under the data dir, durable signature corpus, and the
         # AOT warmup coordinator that replays the corpus before READY.
@@ -935,7 +987,21 @@ class Server:
             "launches": led["launches"],
             "rowsActual": led["rowsActual"],
             "rowsPadded": led["rowsPadded"],
+            # PR 19 fused container kernels: launches/tiles were on the
+            # ledger aggregates but never sampled into the ring
+            "kernelLaunches": led["kernelLaunches"],
+            "kernelTiles": led["kernelTiles"],
         }
+        # SLO counters (docs/observability.md "SLOs & alerting"): bad
+        # http.query counts — 5xx responses and queries over the
+        # latency objective (exact from the fixed histogram buckets) —
+        # whose ring deltas feed the burn-rate windows
+        q_good = self.stats.bucket_count_le(
+            "http.query", self.config.slo_latency_ms / 1e3)
+        counters.update({
+            "sloErrors": self.stats.count_value("http.query_5xx"),
+            "sloSlowQueries": max(q_count - q_good, 0),
+        })
         # cluster-health motion (docs/observability.md "Cluster plane"):
         # per-interval deltas of the PR 13/14 cluster counters so the
         # dashboard timeline shows routing/hedging/partial churn, not
@@ -953,7 +1019,17 @@ class Server:
             "balancerHandoffs": self.cluster.balancer.handoffs
             if self.cluster is not None else 0,
             "fleetEvents": _events_mod.EVENTS.last_seq(),
+            # breaker OPEN transitions and ingest-backpressure 503s:
+            # the flapping/backpressure pathology rules read these
+            "breakerOpens": self.stats.count_value("breaker.opened"),
+            "ingestRejected": self.stats.count_value("ingest.rejected"),
         })
+        # PR 17 tenant plane: total sheds across tenants (the per-tenant
+        # split stays on /debug/vars "tenants"; the ring answers "did
+        # isolation shed anything in that interval")
+        from ..utils import tenant as _tenant
+        counters["tenantSheds"] = sum(
+            t["shed"] for t in _tenant.REGISTRY.snapshot().values())
         # The counter sources are process-wide singletons that predate
         # this Server: the first sample has no previous snapshot, and
         # reporting lifetime totals as "this interval's delta" would
@@ -976,6 +1052,10 @@ class Server:
             "decodePeakBytes": led["decodePeakBytes"],
             "decodeWorkspaceBytes": _mesh_exec.DECODE_WORKSPACE_BYTES,
             "httpQueryP99Ms": round(p99 * 1e3, 3) if p99 else 0.0,
+            # level gauge for the quarantine alert rule: fragments
+            # currently refused by corruption checks
+            "quarantinedFragments": len(
+                self.holder.quarantined_fragments()),
         })
         accepted = self.timeseries.sample(values, force=force)
         if accepted:
@@ -985,11 +1065,56 @@ class Server:
     def _monitor_timeseries(self):
         while not self._closing.wait(self.config.timeseries_interval):
             try:
-                self.sample_timeseries()
+                accepted = self.sample_timeseries()
+                # SLO evaluation rides the sampler cadence (one pass
+                # per accepted sample) so burn-rate windows and ring
+                # intervals stay the same clock — and stays OFF the
+                # query and scrape paths entirely
+                if accepted and self.slo is not None:
+                    self.slo.evaluate()
             except Exception as e:
                 # a silently dead sampler shows a flat-lined
                 # /debug/timeseries that reads as "idle", not "broken"
                 self.logger.error(f"time-series sample failed: {e}")
+
+    def _on_alert_fire(self, alert: dict):
+        """Fire-transition hook (utils/slo.py): capture a diagnostic
+        bundle while the rings still hold the incident's evidence.
+        Rate-limited inside the recorder; runs on the monitor thread."""
+        if self.flightrec is None:
+            return
+        self.flightrec.capture("alert-" + alert["id"], self.build_bundle)
+
+    def build_bundle(self) -> dict:
+        """The flight-recorder payload (docs/observability.md "SLOs &
+        alerting"): every bounded debug surface, snapshotted into one
+        JSON document so post-incident forensics survive ring
+        rotation."""
+        from ..utils import devobs
+        from ..utils.events import EVENTS
+        from .handler import build_debug_vars
+        return {
+            "node": self.config.node_id,
+            "bind": self.config.bind,
+            "vars": build_debug_vars(self.api, self),
+            "timeseries": self.timeseries.snapshot()
+            if self.timeseries is not None else None,
+            "events": EVENTS.snapshot(),
+            "slowLog": self.slowlog.snapshot(),
+            "compiles": devobs.COMPILES.snapshot(),
+            "launches": devobs.LEDGER.snapshot(),
+            "alerts": self.slo.snapshot() if self.slo is not None
+            else None,
+        }
+
+    def capture_bundle(self, reason: str, force: bool = False
+                       ) -> str | None:
+        """On-demand bundle capture (POST /debug/bundle, `pilosa-tpu
+        bundle`); returns the bundle path or None when rate-limited."""
+        if self.flightrec is None:
+            return None
+        return self.flightrec.capture(reason, self.build_bundle,
+                                      force=force)
 
     def _monitor_anti_entropy(self):
         """(server.go:514 monitorAntiEntropy)"""

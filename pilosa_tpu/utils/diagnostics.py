@@ -57,6 +57,17 @@ class DiagnosticsCollector:
             out["numNodes"] = len(cluster.nodes)
             out["replicaN"] = cluster.replica_n
             out["clusterState"] = cluster.state
+        # SLOs & alerting (docs/observability.md): active-alert count
+        # and the newest flight-recorder bundle stamp, so fleet
+        # monitoring sees "this node is paging" without scraping it
+        slo = getattr(self.server, "slo", None)
+        if slo is not None:
+            summary = slo.vars_summary()
+            out["activeAlerts"] = len(summary["active"])
+            out["alertsFired"] = summary["firedTotal"]
+        rec = getattr(self.server, "flightrec", None)
+        if rec is not None:
+            out["lastBundle"] = rec.snapshot()["last"]
         return out
 
     def report_once(self) -> bool:

@@ -154,6 +154,24 @@ class StatsClient:
         with self._lock:
             return self._counts.get(self._key(name), 0.0)
 
+    def bucket_count_le(self, name: str, bound_s: float) -> int:
+        """Observations of one timing series in buckets whose upper
+        edge is <= ``bound_s`` — the SLO engine's good-count reader
+        (utils/slo.py): exact when ``bound_s`` is a TIMING_BUCKETS
+        edge, and conservatively snapped DOWN to the nearest edge
+        otherwise (a query is never counted good on a bucket that may
+        contain over-objective observations)."""
+        with self._lock:
+            h = self._timings.get(self._key(name))
+            if h is None:
+                return 0
+            n = 0
+            for edge, c in zip(TIMING_BUCKETS, h.buckets):
+                if edge > bound_s:
+                    break
+                n += c
+            return n
+
     def timing_totals(self, name: str) -> tuple[int, float]:
         """(count, sum) of one timing series without building the full
         snapshot — the time-series sampler reads these every interval,

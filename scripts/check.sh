@@ -11,7 +11,9 @@ python -m compileall -q pilosa_tpu tests scripts bench.py
 
 # Project invariant analyzer: traced-closure capture, wall-clock timing,
 # bare/swallowed excepts, batcher bypass, cross-thread context
-# discipline, metrics-docs catalog, failpoint-name catalog.  Inline
+# discipline, metrics-docs catalog, failpoint-name catalog, event-names
+# catalog, alert-names catalog (every alert rule id needs a runbook row
+# naming a /debug surface — docs/observability.md).  Inline
 # suppressions require a reason; the analyzer exits non-zero on any
 # finding (run `pilosa-tpu analyze --list-rules` for the rule list).
 python -m pilosa_tpu.analysis
@@ -69,6 +71,11 @@ python -m pilosa_tpu.analysis
 # its every-length truncation / every-byte corruption recovery — and
 # the guarantee that NO corpus state can fail READY — is a crash-safety
 # contract, not a perf test.
+# The SLO/alerting suite (docs/observability.md "SLOs & alerting")
+# rides for the exactness-contract reason too: alert evaluation must
+# never change an answer (SLO-on vs SLO-off byte identity), a muted
+# pager is a silent failure class of its own, and the flight recorder's
+# disk budget is a bounded-resource guarantee.
 # The container-kernel suite (docs/architecture.md "On native code and
 # Pallas") rides with the decode differential above: the Pallas decode
 # and fused-popcount kernels are a THIRD way to materialize every
@@ -81,7 +88,8 @@ JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' -p no:cacheprovider \
     tests/test_device_obs.py tests/test_ingest.py tests/test_wholequery.py \
     tests/test_routing.py tests/test_churn.py \
     tests/test_events.py tests/test_explain.py tests/test_cluster_obs.py \
-    tests/test_qwire.py tests/test_tenant.py tests/test_warmup.py
+    tests/test_qwire.py tests/test_tenant.py tests/test_warmup.py \
+    tests/test_slo.py
 
 # committed bytecode/cache artifacts must never land in the tree (shell
 # stays the right layer for a git-index check)

@@ -61,6 +61,7 @@ tests/test_parallel.py
 tests/test_qwire.py
 tests/test_routing.py
 tests/test_server.py
+tests/test_slo.py
 tests/test_tenant.py
 tests/test_topology.py
 tests/test_warmup.py

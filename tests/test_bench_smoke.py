@@ -83,6 +83,22 @@ def test_bench_smoke_resident_and_budgeted():
     assert ch["hedges"] > 0 and ch["hedge_wins"] > 0
     assert ch["p99_hedged_ms"] < ch["injected_delay_ms"]
     assert ch["p99_hedged_ms"] < ch["p99_unhedged_ms"]
+    # SLO/alerting leg (docs/observability.md "SLOs & alerting"): the
+    # ChaosProxy straggler fired the latency burn-rate alert within 2
+    # evaluation passes, the on-fire hook landed a readable flight-
+    # recorder bundle inside its disk budget, the heal resolved the
+    # alert, and burn-rate evaluation cost nothing on the serving path
+    # (>=0.95x qps vs evaluation-off, answers byte-identical — the
+    # asserts live in bench.py; re-check the published signals)
+    sl = data["slo"]
+    assert sl["alert"]["fired"] is True
+    assert sl["alert"]["evals_to_fire"] <= 2
+    assert sl["alert"]["bundle_ok"] is True and sl["alert"]["bundle_kb"] > 0
+    assert sl["alert"]["budget_held"] is True
+    assert sl["alert"]["resolved"] is True
+    assert sl["answers_identical"] is True
+    assert sl["qps_ratio"] >= 0.95
+    assert sl["evaluations_on"] > 0
     # internal-wire leg (docs/cluster.md "Internal query wire"): binary
     # PTPUQRY1 answered byte-identically to the JSON wire on the same
     # recorded corpus (asserted in bench.py), the roaring framing
